@@ -1,0 +1,115 @@
+"""Unified configuration.
+
+The reference splits config across three tiers — compile-time globals
+(settings.py), per-model env-var conf files (exp_configs/*.conf), and argparse
+CLIs (dist_trainer.py:105-122) — per SURVEY.md §5. Here it is one dataclass
+with per-model presets mirroring exp_configs, env-var overrides, and CLI
+plumbing in train_cli.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # model/data (exp_configs/*.conf fields)
+    dnn: str = "resnet20"
+    dataset: str = "cifar10"
+    data_dir: str = "./data"
+    batch_size: int = 32  # per-worker batch (weak scaling, dl_trainer.py:153-156)
+    lr: float = 0.1
+    max_epochs: int = 141
+    nsteps_update: int = 1  # gradient accumulation micro-steps (dist_trainer.py:77-88)
+
+    # distributed
+    nworkers: int = 1
+    seq_parallel: int = 1  # sequence-parallel mesh extent (TPU extension)
+
+    # MG-WFBP scheduler
+    policy: str = "mgwfbp"  # mgwfbp | threshold | single | wfbp
+    threshold: int = 0  # elements, for policy='threshold' (batch_dist_mpi.sh grid)
+    connection: str = "ici"  # cost-model link class (settings.py CONNECTION)
+    comm_profile: Optional[str] = None  # path to calibrated alpha-beta json
+
+    # numerics
+    dtype: str = "float32"  # param/compute dtype
+    comm_dtype: Optional[str] = None  # wire dtype (settings.FP16 analog -> 'bfloat16')
+    weight_decay: float = 5e-4
+    momentum: float = 0.9
+    norm_clip: Optional[float] = None  # lstm 0.25 / lstman4 400 (dist_trainer.py:56-60)
+
+    # schedule
+    lr_schedule: str = "auto"  # auto | step | cosine | ptb | anneal | vgg | const
+    warmup_epochs: int = 5
+
+    # io / bookkeeping
+    logdir: str = "./logs"
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_epochs: int = 1
+    pretrain: Optional[str] = None
+    seed: int = 0
+    num_batches_per_epoch: Optional[int] = None
+    eval_every_epochs: int = 1
+
+    def tag(self) -> str:
+        from mgwfbp_tpu.utils.logging import run_tag
+
+        return run_tag(dataclasses.asdict(self))
+
+
+# Per-model presets — parity with exp_configs/*.conf (values cited in
+# BASELINE.md "Headline training configs" and reference exp_configs/).
+PRESETS: dict[str, dict] = {
+    "mnistnet": dict(dataset="mnist", batch_size=64, lr=0.01, max_epochs=10,
+                     weight_decay=5e-4, momentum=0.9),
+    "lenet": dict(dataset="mnist", batch_size=64, lr=0.01, max_epochs=10),
+    "resnet20": dict(dataset="cifar10", batch_size=32, lr=0.1, max_epochs=141),
+    "resnet56": dict(dataset="cifar10", batch_size=32, lr=0.1, max_epochs=141),
+    "resnet110": dict(dataset="cifar10", batch_size=32, lr=0.1, max_epochs=141),
+    "vgg16": dict(dataset="cifar10", batch_size=128, lr=0.1, max_epochs=141,
+                  lr_schedule="vgg"),
+    "resnet50": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70),
+    "resnet152": dict(dataset="imagenet", batch_size=32, lr=0.01, max_epochs=70),
+    "densenet121": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "densenet161": dict(dataset="imagenet", batch_size=32, lr=0.01, max_epochs=70),
+    "densenet201": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "googlenet": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "inceptionv3": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "inceptionv4": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "alexnet": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70),
+    "lstm": dict(dataset="ptb", batch_size=20, lr=22.0, max_epochs=40,
+                 lr_schedule="ptb", norm_clip=0.25, weight_decay=0.0, momentum=0.9),
+    "lstman4": dict(dataset="an4", batch_size=4, lr=2e-4, max_epochs=100,
+                    lr_schedule="anneal", norm_clip=400.0, weight_decay=0.0),
+    "fcn5net": dict(dataset="mnist", batch_size=64, lr=0.05, max_epochs=10),
+    "lr": dict(dataset="mnist", batch_size=64, lr=0.01, max_epochs=10),
+}
+
+
+def make_config(dnn: str, **overrides) -> TrainConfig:
+    """Config for a model with its preset applied, then env-var and kwarg
+    overrides (the reference's `${var:-default}` shell pattern,
+    exp_configs/resnet20.conf:1-8)."""
+    base = dict(PRESETS.get(dnn, {}))
+    base["dnn"] = dnn
+    for field in dataclasses.fields(TrainConfig):
+        env = os.environ.get(f"MGWFBP_{field.name.upper()}")
+        if env is not None:
+            base[field.name] = _coerce(env, field.type)
+    base.update({k: v for k, v in overrides.items() if v is not None})
+    return TrainConfig(**base)
+
+
+def _coerce(value: str, typ) -> object:
+    s = str(typ)
+    if "int" in s:
+        return int(value)
+    if "float" in s:
+        return float(value)
+    if "bool" in s:
+        return value.lower() in ("1", "true", "yes")
+    return value

@@ -1,0 +1,36 @@
+"""Parallelism: mesh construction, alpha-beta cost models, the MG-WFBP merge
+solver, bucket layout, and merged-gradient collectives."""
+
+from mgwfbp_tpu.parallel.costmodel import (
+    AlphaBeta,
+    fit_alpha_beta,
+    predict_allreduce_time,
+    lookup_alpha_beta,
+)
+from mgwfbp_tpu.parallel.solver import (
+    LayerSpec,
+    MergeSchedule,
+    mgwfbp_groups,
+    threshold_groups,
+    single_group,
+    build_schedule,
+)
+from mgwfbp_tpu.parallel.buckets import BucketLayout, build_layout
+from mgwfbp_tpu.parallel.mesh import make_mesh, MeshSpec
+
+__all__ = [
+    "AlphaBeta",
+    "fit_alpha_beta",
+    "predict_allreduce_time",
+    "lookup_alpha_beta",
+    "LayerSpec",
+    "MergeSchedule",
+    "mgwfbp_groups",
+    "threshold_groups",
+    "single_group",
+    "build_schedule",
+    "BucketLayout",
+    "build_layout",
+    "make_mesh",
+    "MeshSpec",
+]

@@ -1,0 +1,122 @@
+"""Flat-bucket layout for merge groups.
+
+The reference packs each merge group into one flat torch buffer with per-layer
+offsets and arrival flags (reference distributed_optimizer.py:263-332:
+`_generate_merged_parameters`, `_push_to_buffer`, `_pull_from_buffer`). Under
+XLA there is no incremental arrival — the whole grad pytree exists as traced
+values — so the layout's job is purely structural: map pytree leaves to
+(group, offset) slots so `allreduce.merged_psum` can concatenate each group
+into one collective and slice it back, with the true data dependencies
+preserved for XLA's latency-hiding scheduler.
+
+Groups must be dtype-homogeneous (the reference allocates one buffer with the
+first member's dtype, distributed_optimizer.py:287; mixed dtypes would silently
+upcast). `build_layout` splits any group that crosses a dtype boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Mapping between a flat list of leaves (arrival order) and flat buckets.
+
+    groups: tuples of leaf indices; each group is one collective.
+    offsets: per-group element offsets of each member within the bucket.
+    group_sizes: total element count per bucket.
+    dtypes: one dtype per bucket.
+    """
+
+    groups: tuple[tuple[int, ...], ...]
+    offsets: tuple[tuple[int, ...], ...]
+    group_sizes: tuple[int, ...]
+    dtypes: tuple[Any, ...]
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+
+def build_layout(
+    leaves: Sequence[jax.ShapeDtypeStruct | jax.Array],
+    groups: Sequence[Sequence[int]],
+) -> BucketLayout:
+    """Compute offsets for each group over the given leaves (arrival order),
+    splitting groups at dtype boundaries to keep buckets homogeneous."""
+    out_groups: list[tuple[int, ...]] = []
+    out_offsets: list[tuple[int, ...]] = []
+    out_sizes: list[int] = []
+    out_dtypes: list[Any] = []
+    covered: set[int] = set()
+    for g in groups:
+        sub: list[int] = []
+        cur_dtype = None
+        for idx in g:
+            if idx in covered:
+                raise ValueError(f"leaf {idx} appears in multiple groups")
+            covered.add(idx)
+            dt = leaves[idx].dtype
+            if cur_dtype is not None and dt != cur_dtype and sub:
+                _emit(leaves, sub, out_groups, out_offsets, out_sizes, out_dtypes)
+                sub = []
+            cur_dtype = dt
+            sub.append(idx)
+        if sub:
+            _emit(leaves, sub, out_groups, out_offsets, out_sizes, out_dtypes)
+    if len(covered) != len(leaves):
+        missing = sorted(set(range(len(leaves))) - covered)
+        raise ValueError(f"groups do not cover leaves {missing}")
+    return BucketLayout(
+        groups=tuple(out_groups),
+        offsets=tuple(out_offsets),
+        group_sizes=tuple(out_sizes),
+        dtypes=tuple(out_dtypes),
+    )
+
+
+def _emit(leaves, sub, out_groups, out_offsets, out_sizes, out_dtypes):
+    offs: list[int] = []
+    acc = 0
+    for idx in sub:
+        offs.append(acc)
+        acc += int(np.prod(leaves[idx].shape)) if leaves[idx].shape else 1
+    out_groups.append(tuple(sub))
+    out_offsets.append(tuple(offs))
+    out_sizes.append(acc)
+    out_dtypes.append(leaves[sub[0]].dtype)
+
+
+def pack_group(leaves: Sequence[jax.Array], layout: BucketLayout, gi: int) -> jax.Array:
+    """Concatenate a group's leaves into its flat bucket (one traced value).
+
+    The bucket depends on exactly its members' gradients — XLA sees the true
+    dependency frontier, which is what lets the group's collective launch as
+    soon as the backward has produced those members.
+    """
+    members = layout.groups[gi]
+    return jnp.concatenate([jnp.ravel(leaves[i]) for i in members])
+
+
+def unpack_group(
+    bucket: jax.Array,
+    layout: BucketLayout,
+    gi: int,
+    shapes: Sequence[tuple[int, ...]],
+) -> dict[int, jax.Array]:
+    """Slice a reduced bucket back into per-leaf arrays keyed by leaf index
+    (reference `_pull_from_buffer`, distributed_optimizer.py:318-332)."""
+    out: dict[int, jax.Array] = {}
+    members = layout.groups[gi]
+    offsets = layout.offsets[gi]
+    for i, off in zip(members, offsets):
+        shape = shapes[i]
+        n = int(np.prod(shape)) if shape else 1
+        out[i] = jax.lax.dynamic_slice_in_dim(bucket, off, n).reshape(shape)
+    return out

@@ -1,0 +1,216 @@
+"""Alpha-beta communication cost models.
+
+The merge solver needs a predictor ``t_comm(bytes) = alpha + beta * bytes`` for
+an all-reduce over P workers. The reference hardcodes fitted tables per
+worker-count for 56Gb-IB / 10GbE clusters (reference
+distributed_optimizer.py:166-177, utils.py:62-88) and fits alpha/beta with
+sklearn LinearRegression from a micro-benchmark (reference
+distributed_optimizer.py:105-127). Here:
+
+  * the fit is a closed-form 2-parameter least squares (no sklearn);
+  * built-in tables carry the reference's cluster constants (useful for unit
+    tests and for reproducing the reference's schedules) plus TPU ICI/DCN
+    defaults that `mgwfbp_tpu.profiling.CommunicationProfiler` can re-calibrate
+    on real hardware;
+  * models are (de)serializable so a calibration run can be persisted per
+    topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Mapping, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class AlphaBeta:
+    """Latency/bandwidth parameters of one all-reduce link class.
+
+    alpha: startup latency in seconds per collective.
+    beta: per-byte transfer time in seconds (inverse algorithm bandwidth).
+    """
+
+    alpha: float
+    beta: float
+
+    def predict(self, nbytes) -> float:
+        return self.alpha + self.beta * nbytes
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "AlphaBeta":
+        return cls(**json.loads(s))
+
+
+def predict_allreduce_time(alpha: float, beta: float, nbytes: float) -> float:
+    """t = alpha + beta * size. Parity: reference utils.py:151-154."""
+    return alpha + beta * nbytes
+
+
+def fit_alpha_beta(sizes_bytes: Sequence[float], times_s: Sequence[float]) -> AlphaBeta:
+    """Closed-form least-squares fit of t = alpha + beta*size.
+
+    Replaces the reference's sklearn LinearRegression fit (reference
+    distributed_optimizer.py:108-117) with the 2-parameter normal equations.
+    alpha is clamped at >= 0 (a negative startup latency is meaningless and
+    breaks the merge rule `t_wait < alpha`).
+    """
+    x = np.asarray(sizes_bytes, dtype=np.float64)
+    y = np.asarray(times_s, dtype=np.float64)
+    if x.size < 2:
+        raise ValueError("need at least two (size, time) samples to fit alpha-beta")
+    xm, ym = x.mean(), y.mean()
+    denom = ((x - xm) ** 2).sum()
+    if denom == 0.0:
+        raise ValueError("all sizes identical; cannot fit beta")
+    beta = float(((x - xm) * (y - ym)).sum() / denom)
+    alpha = float(ym - beta * xm)
+    beta = max(beta, 0.0)
+    alpha = max(alpha, 0.0)
+    return AlphaBeta(alpha=alpha, beta=beta)
+
+
+# ---------------------------------------------------------------------------
+# Built-in tables.
+#
+# The reference cluster tables are reproduced as *data* (measured constants of
+# the paper's clusters — reference distributed_optimizer.py:166-177) keyed by
+# worker count. They let unit tests pin the solver to the exact regime the
+# reference was designed for, and serve as a fallback when no calibration
+# profile exists.
+# ---------------------------------------------------------------------------
+
+_REFERENCE_56GBIB: Mapping[int, AlphaBeta] = {
+    16: AlphaBeta(0.00023583677659915685, 4.0594787739537565e-10),
+    8: AlphaBeta(9.75367204301171e-05, 3.0568230536676206e-10),
+    4: AlphaBeta(4.204298980348825e-05, 2.0589360830118177e-10),
+    2: AlphaBeta(2.554691138304671e-06, 9.837548167872609e-11),
+}
+
+_REFERENCE_10GBE: Mapping[int, AlphaBeta] = {
+    16: AlphaBeta(0.0009080981007148093, 7.395651186836712e-10),
+    8: AlphaBeta(0.0005230272768511732, 8.570746975492128e-10),
+    4: AlphaBeta(4.204298980348825e-05, 2.0589360830118177e-10),
+    2: AlphaBeta(2.554691138304671e-06, 9.837548167872609e-11),
+}
+
+# TPU defaults, to be overwritten by calibration (profiling.calibrate_comm).
+# ICI all-reduce on a v5e ring: sub-10us launch overhead, ~100 GB/s+ algorithm
+# bandwidth per link; DCN (multi-slice) is closer to a fast ethernet fabric.
+# These are order-of-magnitude priors, NOT measurements; a calibration run
+# replaces them (SURVEY.md §7 "calibration runner").
+_TPU_ICI_DEFAULT = AlphaBeta(alpha=8e-06, beta=2.2e-11)
+_TPU_DCN_DEFAULT = AlphaBeta(alpha=2.5e-04, beta=4.0e-10)
+
+_CONNECTIONS: Mapping[str, Mapping[int, AlphaBeta]] = {
+    "56GbIB": _REFERENCE_56GBIB,
+    "10GbE": _REFERENCE_10GBE,
+}
+
+
+def lookup_alpha_beta(connection: str, nworkers: int) -> AlphaBeta:
+    """Resolve an AlphaBeta for a link class and worker count.
+
+    connection: one of '56GbIB', '10GbE' (reference settings.py CONNECTION),
+    'ici', or 'dcn'. The reference tables carry {2,4,8,16}; intermediate
+    counts log2-interpolate between the bracketing entries, larger counts
+    extrapolate alpha from the largest entry (ring all-reduce startup grows
+    ~linearly in hop count).
+    """
+    if connection == "ici":
+        # alpha grows with ring hops; beta (algorithm bandwidth) is roughly
+        # size-independent for a bidirectional ring.
+        ab = _TPU_ICI_DEFAULT
+        hops = max(nworkers - 1, 1)
+        return AlphaBeta(alpha=ab.alpha * (1.0 + 0.1 * hops), beta=ab.beta)
+    if connection == "dcn":
+        return _TPU_DCN_DEFAULT
+    table = _CONNECTIONS.get(connection)
+    if table is None:
+        raise KeyError(
+            f"unknown connection {connection!r}; expected one of "
+            f"{sorted(_CONNECTIONS)} or 'ici'/'dcn'"
+        )
+    if nworkers in table:
+        return table[nworkers]
+    known = sorted(table)
+    if nworkers < known[0]:
+        return table[known[0]]
+    if nworkers > known[-1]:
+        base = table[known[-1]]
+        scale = np.log2(nworkers) / np.log2(known[-1])
+        return AlphaBeta(alpha=base.alpha * scale, beta=base.beta)
+    # intermediate count: log2-interpolate between the bracketing entries
+    lo = max(k for k in known if k < nworkers)
+    hi = min(k for k in known if k > nworkers)
+    t = (np.log2(nworkers) - np.log2(lo)) / (np.log2(hi) - np.log2(lo))
+    a = table[lo].alpha * (1 - t) + table[hi].alpha * t
+    b = table[lo].beta * (1 - t) + table[hi].beta * t
+    return AlphaBeta(alpha=float(a), beta=float(b))
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoLevelAlphaBeta:
+    """Two-level (ICI within a slice + DCN across slices) cost model.
+
+    The reference's single flat alpha-beta pair per world size cannot describe
+    a multi-slice TPU pod (SURVEY.md §7 "Hard parts"). A hierarchical
+    all-reduce is reduce-scatter(ici) -> all-reduce(dcn) -> all-gather(ici);
+    its cost is approximately the ICI term on the full payload plus the DCN
+    term on the per-slice shard.
+    """
+
+    ici: AlphaBeta
+    dcn: AlphaBeta
+    ici_size: int  # chips per slice
+    dcn_size: int  # number of slices
+
+    def predict(self, nbytes) -> float:
+        if self.dcn_size <= 1:
+            return self.ici.predict(nbytes)
+        shard = nbytes / max(self.ici_size, 1)
+        return self.ici.predict(nbytes) + self.dcn.predict(shard)
+
+    @property
+    def alpha(self) -> float:
+        # Effective startup cost of one merged collective: both levels pay one
+        # launch. Used by the merge rule `t_wait < alpha`.
+        if self.dcn_size <= 1:
+            return self.ici.alpha
+        return self.ici.alpha + self.dcn.alpha
+
+
+def save_profile(path: str, model: AlphaBeta | TwoLevelAlphaBeta) -> None:
+    with open(path, "w") as f:
+        if isinstance(model, TwoLevelAlphaBeta):
+            json.dump(
+                {
+                    "kind": "two_level",
+                    "ici": dataclasses.asdict(model.ici),
+                    "dcn": dataclasses.asdict(model.dcn),
+                    "ici_size": model.ici_size,
+                    "dcn_size": model.dcn_size,
+                },
+                f,
+            )
+        else:
+            json.dump({"kind": "flat", **dataclasses.asdict(model)}, f)
+
+
+def load_profile(path: str) -> AlphaBeta | TwoLevelAlphaBeta:
+    with open(path) as f:
+        d = json.load(f)
+    kind = d.pop("kind", "flat")
+    if kind == "two_level":
+        return TwoLevelAlphaBeta(
+            ici=AlphaBeta(**d["ici"]),
+            dcn=AlphaBeta(**d["dcn"]),
+            ici_size=d["ici_size"],
+            dcn_size=d["dcn_size"],
+        )
+    return AlphaBeta(**d)

@@ -1,0 +1,98 @@
+"""Device mesh and process bootstrap.
+
+Replaces the reference's Horovod/MPI bootstrap (`hvd.init/rank/size`,
+dist_trainer.py:133; mpirun + hostfiles, dist_mpi.sh:8-16) with
+`jax.distributed` + a named `jax.sharding.Mesh`. One process drives all local
+chips (subsuming the reference's `nn.DataParallel` intra-node path,
+dl_trainer.py:193-198).
+
+Axes:
+  data  — data parallelism (the reference's entire parallelism model)
+  seq   — optional sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    data: int = -1  # -1: all remaining devices
+    seq: int = 1
+
+    def axis_names(self) -> tuple[str, ...]:
+        return (DATA_AXIS, SEQ_AXIS)
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap (reference: `hvd.init()` / mpirun). No-op when
+    single-process or when jax.distributed is already initialized."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("MGWFBP_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already initialized" not in str(e).lower():
+            raise
+
+
+def make_mesh(
+    spec: MeshSpec = MeshSpec(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, seq) mesh over the available devices.
+
+    The device order follows jax.devices(), which keeps ICI neighbours adjacent
+    on TPU so the data-axis ring rides ICI links.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    seq = max(spec.seq, 1)
+    if n % seq != 0:
+        raise ValueError(f"{n} devices not divisible by seq={seq}")
+    data = spec.data if spec.data > 0 else n // seq
+    if data * seq != n:
+        raise ValueError(f"mesh {data}x{seq} != {n} devices")
+    arr = np.asarray(devs).reshape(data, seq)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding for input arrays (reference DistributedSampler
+    equivalent: each data-axis member sees 1/N of the global batch)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Parameters are replicated across the mesh — the reference's
+    `broadcast_parameters` initial sync (distributed_optimizer.py:474-503)
+    becomes a sharding constraint."""
+    return NamedSharding(mesh, P())
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -1,0 +1,260 @@
+"""MG-WFBP merge-group solver.
+
+Decides which per-layer gradients to fuse into a single all-reduce so that
+communication maximally overlaps the backward pass while amortizing startup
+latency (alpha). This is the framework's core contribution, re-derived from the
+reference algorithm's semantics (reference distributed_optimizer.py:164-261 for
+the adaptive policy, :140-162 for the static threshold policy; papers
+arXiv:1811.11141 / arXiv:1912.09268).
+
+Pure functions on plain data — hardware-agnostic math, exhaustively
+unit-testable (SURVEY.md §4). The JAX lowering lives in
+`mgwfbp_tpu.parallel.buckets` / `allreduce`.
+
+Conventions (differ from the reference's, chosen for clarity):
+  * All sequences are in **gradient-arrival order**: index 0 is the first
+    gradient produced by the backward pass, i.e. the LAST forward layer.
+    (The reference stores layers in forward order and scans from the end;
+    arrival order makes the recurrences read left-to-right.)
+  * ``tb[i]`` is the backward-compute duration attributable to layer i, so
+    gradient i is ready at ``ready[i] = tb[0] + ... + tb[i]``.
+  * Group lists are emitted in arrival order as index tuples into the input.
+
+The merge rule, per the paper: scanning arrivals in order with a current open
+group whose collective would start at ``start`` and occupy the link for
+``comm`` seconds, the next gradient (ready at ``r``) is merged into the group
+when either
+  (a) the group's collective could not have started yet anyway
+      (``start > r`` — merging costs no extra waiting), or
+  (b) the wait it introduces is cheaper than the startup latency another
+      collective would pay (``r - start < alpha``).
+Otherwise the group is closed and a new one opened.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from mgwfbp_tpu.parallel.costmodel import AlphaBeta, TwoLevelAlphaBeta
+
+CostFn = Callable[[float], float]  # bytes -> seconds
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    """One gradient tensor, in arrival order."""
+
+    name: str
+    size: int  # number of elements
+    itemsize: int = 4  # bytes per element (4 fp32, 2 bf16)
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeSchedule:
+    """Solver output: groups of arrival-order indices plus predictions."""
+
+    groups: tuple[tuple[int, ...], ...]
+    layer_names: tuple[str, ...]
+    predicted_total_time: float  # ready-to-step wall clock, seconds
+    predicted_nonoverlap_time: float  # comm time not hidden by backward
+    predicted_comm_time: float  # sum of per-group collective durations
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    def named_groups(self) -> list[list[str]]:
+        return [[self.layer_names[i] for i in g] for g in self.groups]
+
+
+def _simulate(
+    groups: Sequence[Sequence[int]],
+    sizes_bytes: Sequence[int],
+    tb: Sequence[float],
+    cost: CostFn,
+) -> tuple[float, float, float]:
+    """Simulate the backward/comm overlap timeline for a fixed grouping.
+
+    Returns (total_time, nonoverlap_time, comm_time). A group's collective can
+    start when its last member's gradient is ready and the link is free
+    (reference's taoc recurrence, distributed_optimizer.py:187-192, expressed
+    over groups instead of layers).
+    """
+    ready = np.cumsum(np.asarray(tb, dtype=np.float64))
+    bwd_end = float(ready[-1]) if len(ready) else 0.0
+    link_free = 0.0
+    comm_sum = 0.0
+    for g in groups:
+        gbytes = float(sum(sizes_bytes[i] for i in g))
+        t = cost(gbytes)
+        start = max(link_free, float(ready[max(g)]))
+        link_free = start + t
+        comm_sum += t
+    total = max(bwd_end, link_free)
+    return total, max(link_free - bwd_end, 0.0), comm_sum
+
+
+def mgwfbp_groups(
+    sizes: Sequence[int],
+    tb: Sequence[float],
+    alpha: float,
+    cost: CostFn,
+    itemsize: int | Sequence[int] = 4,
+) -> list[list[int]]:
+    """The MG-WFBP adaptive merge scan (reference semantics, arrival order).
+
+    sizes: element counts per gradient, arrival order.
+    tb: backward-compute seconds per gradient, arrival order.
+    alpha: startup latency a merge saves (rule (b)).
+    cost: bytes -> seconds predictor for one all-reduce.
+    itemsize: bytes per element, scalar or per-layer.
+    """
+    L = len(sizes)
+    if L == 0:
+        return []
+    if L != len(tb):
+        raise ValueError(f"sizes ({L}) and tb ({len(tb)}) length mismatch")
+    itemsizes = [itemsize] * L if isinstance(itemsize, int) else list(itemsize)
+    if len(itemsizes) != L:
+        raise ValueError(f"itemsize ({len(itemsizes)}) and sizes ({L}) length mismatch")
+    nbytes = [int(s) * it for s, it in zip(sizes, itemsizes)]
+    ready = np.cumsum(np.asarray(tb, dtype=np.float64)).tolist()
+
+    # Mutable per-position state: mass[i] holds the byte payload accumulated at
+    # scan position i (the open group's total rides along the scan, mirroring
+    # the reference's p[l-1] += p[l] at :194-201).
+    mass = list(nbytes)
+    tc = [cost(b) for b in mass]
+
+    def comm_start(i: int) -> float:
+        # Link-busy recurrence over positions 0..i: start[j] =
+        # max(start[j-1] + tc[j-1], ready[j]). Positions whose mass was merged
+        # away have tc == 0 and do not occupy the link.
+        start = ready[0]
+        for j in range(1, i + 1):
+            start = max(start + tc[j - 1], ready[j])
+        return start
+
+    groups: list[list[int]] = []
+    group: list[int] = [0]
+    for i in range(L - 1):
+        # The open group's payload currently sits at position i.
+        r_next = ready[i + 1]
+        start_i = comm_start(i)
+        merged = False
+        if r_next < start_i + tc[i]:
+            # Comm for the open group is still in flight (or hasn't begun)
+            # when the next gradient arrives.
+            if start_i > r_next:
+                merged = True  # rule (a): no extra wait introduced
+            elif r_next - start_i < alpha:
+                merged = True  # rule (b): wait cheaper than another startup
+        if merged:
+            mass[i + 1] += mass[i]
+            mass[i] = 0
+            tc[i] = 0.0
+            tc[i + 1] = cost(mass[i + 1])
+            group.append(i + 1)
+        else:
+            groups.append(group)
+            group = [i + 1]
+    groups.append(group)
+    return groups
+
+
+def threshold_groups(sizes: Sequence[int], threshold: int) -> list[list[int]]:
+    """Static merge policy: pack arrivals until cumulative elements reach
+    ``threshold`` (reference distributed_optimizer.py:140-162).
+
+    threshold <= 0 means no merging (pure WFBP: one group per layer);
+    a huge threshold yields a single group (SyncEASGD-style).
+    """
+    L = len(sizes)
+    if threshold <= 0:
+        return [[i] for i in range(L)]
+    groups: list[list[int]] = []
+    group: list[int] = []
+    acc = 0
+    for i in range(L):
+        group.append(i)
+        acc += int(sizes[i])
+        if acc >= threshold:
+            groups.append(group)
+            group = []
+            acc = 0
+    if group:
+        groups.append(group)
+    return groups
+
+
+def single_group(sizes: Sequence[int]) -> list[list[int]]:
+    """All gradients in one collective (threshold=inf limit)."""
+    return [list(range(len(sizes)))] if len(sizes) else []
+
+
+def build_schedule(
+    layers: Sequence[LayerSpec],
+    tb: Optional[Sequence[float]] = None,
+    *,
+    policy: str = "mgwfbp",
+    cost_model: AlphaBeta | TwoLevelAlphaBeta | None = None,
+    threshold: int = 0,
+) -> MergeSchedule:
+    """Build a MergeSchedule for gradient tensors in arrival order.
+
+    policy: 'mgwfbp' (adaptive; needs tb and cost_model), 'threshold',
+    'single', or 'wfbp' (no merging). Mirrors the reference's policy dispatch
+    (distributed_optimizer.py:263-270: adaptive iff ADAPTIVE_MERGE and
+    layerwise_times available, else threshold).
+    """
+    sizes = [l.size for l in layers]
+    names = tuple(l.name for l in layers)
+    nbytes = [l.nbytes for l in layers]
+
+    if policy == "mgwfbp":
+        if tb is None or cost_model is None:
+            raise ValueError("policy 'mgwfbp' requires tb and cost_model")
+        groups = mgwfbp_groups(
+            sizes,
+            tb,
+            alpha=cost_model.alpha,
+            cost=cost_model.predict,
+            itemsize=[l.itemsize for l in layers],
+        )
+    elif policy == "threshold":
+        groups = threshold_groups(sizes, threshold)
+    elif policy == "single":
+        groups = single_group(sizes)
+    elif policy == "wfbp":
+        groups = threshold_groups(sizes, 0)
+    else:
+        raise ValueError(f"unknown policy {policy!r}")
+
+    if tb is not None and cost_model is not None and len(layers):
+        total, nonoverlap, comm = _simulate(groups, nbytes, tb, cost_model.predict)
+    else:
+        total = nonoverlap = comm = float("nan")
+    return MergeSchedule(
+        groups=tuple(tuple(g) for g in groups),
+        layer_names=names,
+        predicted_total_time=total,
+        predicted_nonoverlap_time=nonoverlap,
+        predicted_comm_time=comm,
+    )
+
+
+def check_unique(names: Sequence[str]) -> None:
+    """Raise on duplicate layer names (reference utils.py:160-167, called from
+    distributed_optimizer.py:204)."""
+    seen: set[str] = set()
+    for n in names:
+        if n in seen:
+            raise ValueError(f"duplicate layer name: {n!r}")
+        seen.add(n)

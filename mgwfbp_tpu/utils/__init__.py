@@ -1,0 +1,3 @@
+from mgwfbp_tpu.utils.logging import get_logger, run_tag
+
+__all__ = ["get_logger", "run_tag"]

@@ -1,0 +1,26 @@
+"""Force an 8-device virtual CPU mesh for all tests.
+
+The TPU-world answer to the reference's "multi-node without a cluster"
+(`cluster4` = localhost slots=4, mpirun --oversubscribe — SURVEY.md §4): run
+the real sharded programs on N virtual CPU devices. Must run before jax
+initializes its backends, hence the env mutation at conftest import time.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override any TPU tunnel platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize registers the TPU-tunnel backend programmatically, so
+# the env var alone does not win; force CPU through the config API too.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from mgwfbp_tpu.parallel.costmodel import (
+    AlphaBeta,
+    TwoLevelAlphaBeta,
+    fit_alpha_beta,
+    load_profile,
+    lookup_alpha_beta,
+    predict_allreduce_time,
+    save_profile,
+)
+
+
+def test_predict_linear():
+    assert predict_allreduce_time(1e-4, 1e-10, 0) == pytest.approx(1e-4)
+    assert predict_allreduce_time(1e-4, 1e-10, 1e9) == pytest.approx(0.1001, rel=1e-3)
+
+
+def test_fit_recovers_parameters():
+    rng = np.random.RandomState(0)
+    alpha, beta = 3.2e-4, 5.0e-10
+    sizes = np.arange(8_192, 504_000, 8_192) * 4.0  # reference sweep, bytes
+    times = alpha + beta * sizes + rng.normal(0, 1e-7, sizes.shape)
+    ab = fit_alpha_beta(sizes, times)
+    assert ab.alpha == pytest.approx(alpha, rel=0.05)
+    assert ab.beta == pytest.approx(beta, rel=0.05)
+
+
+def test_fit_clamps_negative_alpha():
+    sizes = [1e6, 2e6, 3e6]
+    times = [0.001, 0.003, 0.005]  # implies negative intercept
+    ab = fit_alpha_beta(sizes, times)
+    assert ab.alpha >= 0.0
+
+
+def test_fit_rejects_degenerate():
+    with pytest.raises(ValueError):
+        fit_alpha_beta([100.0], [0.1])
+    with pytest.raises(ValueError):
+        fit_alpha_beta([100.0, 100.0], [0.1, 0.2])
+
+
+def test_reference_tables():
+    # Values from reference distributed_optimizer.py:166-177.
+    ab = lookup_alpha_beta("56GbIB", 16)
+    assert ab.alpha == pytest.approx(0.00023583677659915685)
+    assert ab.beta == pytest.approx(4.0594787739537565e-10)
+    ab10 = lookup_alpha_beta("10GbE", 8)
+    assert ab10.alpha == pytest.approx(0.0005230272768511732)
+
+
+def test_lookup_extrapolates_and_validates():
+    big = lookup_alpha_beta("56GbIB", 64)
+    base = lookup_alpha_beta("56GbIB", 16)
+    assert big.alpha > base.alpha
+    assert lookup_alpha_beta("ici", 8).alpha > lookup_alpha_beta("ici", 2).alpha
+    with pytest.raises(KeyError):
+        lookup_alpha_beta("carrier-pigeon", 4)
+
+
+def test_two_level_model():
+    ici = AlphaBeta(1e-5, 1e-11)
+    dcn = AlphaBeta(3e-4, 5e-10)
+    m = TwoLevelAlphaBeta(ici=ici, dcn=dcn, ici_size=8, dcn_size=4)
+    single = TwoLevelAlphaBeta(ici=ici, dcn=dcn, ici_size=8, dcn_size=1)
+    n = 1e8
+    assert m.predict(n) > single.predict(n)
+    assert m.alpha == pytest.approx(ici.alpha + dcn.alpha)
+    assert single.predict(n) == pytest.approx(ici.predict(n))
+
+
+def test_profile_roundtrip(tmp_path):
+    p = tmp_path / "ab.json"
+    save_profile(str(p), AlphaBeta(1e-5, 2e-11))
+    m = load_profile(str(p))
+    assert isinstance(m, AlphaBeta) and m.beta == pytest.approx(2e-11)
+    p2 = tmp_path / "two.json"
+    save_profile(
+        str(p2),
+        TwoLevelAlphaBeta(AlphaBeta(1e-5, 1e-11), AlphaBeta(3e-4, 5e-10), 8, 4),
+    )
+    m2 = load_profile(str(p2))
+    assert isinstance(m2, TwoLevelAlphaBeta) and m2.dcn_size == 4
