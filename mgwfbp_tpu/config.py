@@ -97,6 +97,11 @@ def make_config(dnn: str, **overrides) -> TrainConfig:
     base = dict(PRESETS.get(dnn, {}))
     base["dnn"] = dnn
     for field in dataclasses.fields(TrainConfig):
+        if field.name == "dnn":
+            # dnn selected the preset above; letting a lingering MGWFBP_DNN
+            # env var override it here would mix one model's name with
+            # another's hyperparameters. Model choice comes from the caller.
+            continue
         env = os.environ.get(f"MGWFBP_{field.name.upper()}")
         if env is not None:
             base[field.name] = _coerce(env, field.type)

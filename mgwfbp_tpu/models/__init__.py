@@ -1,0 +1,307 @@
+"""Model zoo registry.
+
+Parity target: reference dl_trainer.py:87-135 `create_net`, which dispatches
+22 model names to local modules or torchvision. Here every architecture is a
+Flax module built in-repo (SURVEY.md §2.7 inventory). `create_model` returns
+the module plus a `ModelMeta` describing the canonical input so callers
+(trainer, tests, bench) can build example batches without per-model switches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+# Dataset -> (num_classes, example input HWC / sequence spec)
+DATASET_CLASSES = {
+    "mnist": 10,
+    "cifar10": 10,
+    "imagenet": 1000,
+    "ptb": 10000,
+    "an4": 29,  # CTC label alphabet, reference labels.json (29 chars)
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelMeta:
+    name: str
+    dataset: str
+    num_classes: int
+    # example input shape WITHOUT batch dim; image models: (H, W, C) NHWC;
+    # lm models: (seq_len,) int tokens; ctc audio: (time, freq)
+    input_shape: tuple[int, ...]
+    input_dtype: Any = jnp.float32
+    task: str = "classify"  # classify | lm | ctc
+    has_aux_logits: bool = False  # googlenet/inceptionv3 style aux heads
+    has_carry: bool = False  # recurrent models with BPTT carry state
+
+
+_REGISTRY: dict[str, Callable[[int], tuple[Any, ModelMeta]]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def model_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# canonical image input per dataset (used to keep meta.input_shape consistent
+# under dataset overrides)
+DATASET_INPUT_HWC = {
+    "mnist": (28, 28, 1),
+    "cifar10": (32, 32, 3),
+    "imagenet": (224, 224, 3),
+}
+
+
+def create_model(name: str, dataset: Optional[str] = None, num_classes: Optional[int] = None):
+    """Build (module, meta) for a model name (reference create_net,
+    dl_trainer.py:87-135). dataset/num_classes override the model's default;
+    for image models a dataset override also retargets meta.input_shape so
+    callers building batches from meta stay consistent."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; known: {model_names()}")
+    factory = _REGISTRY[name]
+    module, meta = factory(num_classes)
+    if dataset is not None and dataset != meta.dataset:
+        nc = num_classes or DATASET_CLASSES.get(dataset, meta.num_classes)
+        if nc != meta.num_classes:
+            module, meta = factory(nc)
+        updates: dict = {"dataset": dataset}
+        if meta.task == "classify" and dataset in DATASET_INPUT_HWC:
+            updates["input_shape"] = DATASET_INPUT_HWC[dataset]
+        meta = dataclasses.replace(meta, **updates)
+    return module, meta
+
+
+def _image_meta(name, dataset, nc, hwc, **kw) -> ModelMeta:
+    return ModelMeta(name=name, dataset=dataset, num_classes=nc, input_shape=hwc, **kw)
+
+
+MNIST_HWC = (28, 28, 1)
+CIFAR_HWC = (32, 32, 3)
+IMAGENET_HWC = (224, 224, 3)
+
+
+@register("mnistnet")
+def _mnistnet(nc):
+    from mgwfbp_tpu.models.simple import MnistNet
+
+    nc = nc or 10
+    return MnistNet(nc), _image_meta("mnistnet", "mnist", nc, MNIST_HWC)
+
+
+@register("lenet")
+def _lenet(nc):
+    from mgwfbp_tpu.models.simple import LeNet
+
+    nc = nc or 10
+    return LeNet(nc), _image_meta("lenet", "mnist", nc, MNIST_HWC)
+
+
+@register("fcn5net")
+def _fcn5(nc):
+    from mgwfbp_tpu.models.simple import FCN5Net
+
+    nc = nc or 10
+    return FCN5Net(nc), _image_meta("fcn5net", "mnist", nc, MNIST_HWC)
+
+
+@register("lr")
+def _linreg(nc):
+    from mgwfbp_tpu.models.simple import LinearRegression
+
+    nc = nc or 10
+    return LinearRegression(nc), _image_meta("lr", "mnist", nc, MNIST_HWC)
+
+
+@register("caffe_cifar")
+def _caffe_cifar(nc):
+    from mgwfbp_tpu.models.simple import CaffeCifar
+
+    nc = nc or 10
+    return CaffeCifar(nc), _image_meta("caffe_cifar", "cifar10", nc, CIFAR_HWC)
+
+
+def _register_cifar_resnet(depth: int):
+    @register(f"resnet{depth}")
+    def _factory(nc, depth=depth):
+        from mgwfbp_tpu.models.resnet_cifar import CifarResNet
+
+        nc = nc or 10
+        return (
+            CifarResNet(depth=depth, num_classes=nc),
+            _image_meta(f"resnet{depth}", "cifar10", nc, CIFAR_HWC),
+        )
+
+
+for _d in (20, 32, 44, 56, 110):
+    _register_cifar_resnet(_d)
+
+
+@register("preresnet110")
+def _preresnet110(nc):
+    from mgwfbp_tpu.models.resnet_cifar import preresnet110
+
+    nc = nc or 10
+    return preresnet110(nc), _image_meta("preresnet110", "cifar10", nc, CIFAR_HWC)
+
+
+@register("preresnet20")
+def _preresnet20(nc):
+    from mgwfbp_tpu.models.resnet_cifar import preresnet20
+
+    nc = nc or 10
+    return preresnet20(nc), _image_meta("preresnet20", "cifar10", nc, CIFAR_HWC)
+
+
+def _register_imagenet_resnet(depth: int):
+    @register(f"resnet{depth}")
+    def _factory(nc, depth=depth):
+        from mgwfbp_tpu.models.resnet_imagenet import imagenet_resnet
+
+        nc = nc or 1000
+        return (
+            imagenet_resnet(depth, nc),
+            _image_meta(f"resnet{depth}", "imagenet", nc, IMAGENET_HWC),
+        )
+
+
+for _d in (18, 34, 50, 101, 152):
+    _register_imagenet_resnet(_d)
+
+
+def _register_vgg_cifar(depth: int):
+    @register(f"vgg{depth}")
+    def _factory(nc, depth=depth):
+        from mgwfbp_tpu.models.vgg import VGGCifar
+
+        nc = nc or 10
+        return (
+            VGGCifar(cfg=f"vgg{depth}", num_classes=nc),
+            _image_meta(f"vgg{depth}", "cifar10", nc, CIFAR_HWC),
+        )
+
+
+for _d in (11, 13, 16, 19):
+    _register_vgg_cifar(_d)
+
+
+@register("vgg16i")
+def _vgg16i(nc):
+    from mgwfbp_tpu.models.vgg import VGGImageNet
+
+    nc = nc or 1000
+    return (
+        VGGImageNet(cfg="vgg16", num_classes=nc),
+        _image_meta("vgg16i", "imagenet", nc, IMAGENET_HWC),
+    )
+
+
+@register("alexnet")
+def _alexnet(nc):
+    from mgwfbp_tpu.models.alexnet import AlexNet
+
+    nc = nc or 1000
+    return AlexNet(nc), _image_meta("alexnet", "imagenet", nc, IMAGENET_HWC)
+
+
+@register("resnext29")
+def _resnext29(nc):
+    from mgwfbp_tpu.models.resnext import ResNeXt29
+
+    nc = nc or 10
+    return ResNeXt29(num_classes=nc), _image_meta("resnext29", "cifar10", nc, CIFAR_HWC)
+
+
+@register("densenet")
+def _densenet_bc(nc):
+    from mgwfbp_tpu.models.densenet import densenet_bc_100_12
+
+    nc = nc or 10
+    return densenet_bc_100_12(nc), _image_meta("densenet", "cifar10", nc, CIFAR_HWC)
+
+
+def _register_imagenet_densenet(depth: int):
+    @register(f"densenet{depth}")
+    def _factory(nc, depth=depth):
+        from mgwfbp_tpu.models.densenet import imagenet_densenet
+
+        nc = nc or 1000
+        return (
+            imagenet_densenet(depth, nc),
+            _image_meta(f"densenet{depth}", "imagenet", nc, IMAGENET_HWC),
+        )
+
+
+for _d in (121, 161, 201):
+    _register_imagenet_densenet(_d)
+
+
+@register("googlenet")
+def _googlenet(nc):
+    from mgwfbp_tpu.models.googlenet import GoogLeNet
+
+    nc = nc or 1000
+    return (
+        GoogLeNet(num_classes=nc),
+        _image_meta("googlenet", "imagenet", nc, IMAGENET_HWC, has_aux_logits=True),
+    )
+
+
+@register("inceptionv3")
+def _inceptionv3(nc):
+    from mgwfbp_tpu.models.inception import InceptionV3
+
+    nc = nc or 1000
+    return (
+        InceptionV3(num_classes=nc),
+        _image_meta("inceptionv3", "imagenet", nc, (299, 299, 3), has_aux_logits=True),
+    )
+
+
+@register("inceptionv4")
+def _inceptionv4(nc):
+    from mgwfbp_tpu.models.inception import InceptionV4
+
+    nc = nc or 1000
+    return (
+        InceptionV4(num_classes=nc),
+        _image_meta("inceptionv4", "imagenet", nc, (299, 299, 3)),
+    )
+
+
+@register("lstm")
+def _lstm(nc):
+    from mgwfbp_tpu.models.lstm import PTBLSTM
+
+    nc = nc or DATASET_CLASSES["ptb"]
+    return (
+        PTBLSTM(vocab_size=nc),
+        ModelMeta(
+            name="lstm", dataset="ptb", num_classes=nc, input_shape=(35,),
+            input_dtype=jnp.int32, task="lm", has_carry=True,
+        ),
+    )
+
+
+@register("lstman4")
+def _lstman4(nc):
+    from mgwfbp_tpu.models.deepspeech import DeepSpeech
+
+    nc = nc or DATASET_CLASSES["an4"]
+    return (
+        DeepSpeech(num_classes=nc),
+        ModelMeta(
+            name="lstman4", dataset="an4", num_classes=nc,
+            input_shape=(201, 161), task="ctc",  # (time, freq=161)
+        ),
+    )

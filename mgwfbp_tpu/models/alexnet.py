@@ -1,0 +1,45 @@
+"""AlexNet with local response normalization.
+
+Parity target: reference models/alexnet.py:9-87 ("AlexNet + LRN", SURVEY.md
+§2.7) and the torchvision alexnet dispatch (dl_trainer.py:123). NHWC / Flax;
+LRN from models/common.py.
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import (
+    conv_kernel_init,
+    flatten,
+    local_response_norm,
+    max_pool,
+)
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.relu(
+            nn.Conv(64, (11, 11), (4, 4), padding=((2, 2), (2, 2)),
+                    kernel_init=conv_kernel_init)(x)
+        )
+        x = local_response_norm(x)
+        x = max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), padding="SAME",
+                            kernel_init=conv_kernel_init)(x))
+        x = local_response_norm(x)
+        x = max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), kernel_init=conv_kernel_init)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), kernel_init=conv_kernel_init)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), kernel_init=conv_kernel_init)(x))
+        x = max_pool(x, (3, 3), (2, 2))
+        x = flatten(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,125 @@
+"""Shared building blocks for the model zoo.
+
+TPU-first conventions used throughout `mgwfbp_tpu.models`:
+  * NHWC layout (XLA:TPU's native conv layout — feeds the MXU without
+    transposes; the reference's NCHW is a CUDA/cuDNN idiom).
+  * `flax.linen` modules with a `train: bool` argument controlling BatchNorm
+    running-statistics mode and dropout.
+  * Kaiming/He fan-out initialization for convs, matching the reference
+    models' `init.kaiming_normal_` style (reference models/resnet.py,
+    models/imagenet_resnet.py weight-init loops).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+# He/fan-out normal: the standard ResNet conv init.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+dense_kernel_init = nn.initializers.lecun_normal()
+
+
+class ConvBN(nn.Module):
+    """Conv + BatchNorm (+ optional relu) — the workhorse of every CNN here.
+
+    BatchNorm carries running stats in the `batch_stats` collection; callers
+    thread `train` down so a single module definition serves both the jitted
+    train step and eval.
+    """
+
+    features: int
+    kernel_size: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    use_relu: bool = True
+    groups: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.Conv(
+            self.features,
+            kernel_size=tuple(self.kernel_size),
+            strides=tuple(self.strides),
+            padding=self.padding,
+            use_bias=False,
+            feature_group_count=self.groups,
+            kernel_init=conv_kernel_init,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )(x)
+        if self.use_relu:
+            x = nn.relu(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    """Post-activation residual basic block: conv-bn-relu, conv-bn, add, relu.
+    Shared by the CIFAR and ImageNet ResNets (reference models/resnet.py
+    BasicBlock / models/imagenet_resnet.py BasicBlock are the same block)."""
+
+    features: int
+    strides: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        residual = x
+        y = ConvBN(self.features, (3, 3), (self.strides, self.strides))(x, train)
+        y = ConvBN(self.features, (3, 3), use_relu=False)(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features, (1, 1), (self.strides, self.strides),
+                use_relu=False, name="shortcut",
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+def local_response_norm(
+    x: jax.Array, size: int = 5, alpha: float = 1e-4, beta: float = 0.75, k: float = 2.0
+) -> jax.Array:
+    """Local response normalization across channels (AlexNet's LRN; reference
+    models/alexnet.py uses an LRN layer). NHWC input; window over C.
+
+    y_c = x_c / (k + alpha/size * sum_{c' in window} x_{c'}^2)^beta
+    """
+    sq = jnp.square(x)
+    half = size // 2
+    # Sum a sliding window over the channel axis via reduce_window (XLA folds
+    # this into a cheap fused op; channel counts here are small).
+    summed = jax.lax.reduce_window(
+        sq,
+        0.0,
+        jax.lax.add,
+        window_dimensions=(1, 1, 1, size),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (0, 0), (0, 0), (half, size - 1 - half)),
+    )
+    return x / jnp.power(k + (alpha / size) * summed, beta)
+
+
+def max_pool(x, window=(2, 2), strides=None, padding="VALID"):
+    return nn.max_pool(x, window, strides or window, padding)
+
+
+def avg_pool(x, window=(2, 2), strides=None, padding="VALID"):
+    return nn.avg_pool(x, window, strides or window, padding)
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    """NHWC -> NC global average pool."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def classifier_head(x: jax.Array, num_classes: int, name: str = "fc") -> jax.Array:
+    return nn.Dense(num_classes, kernel_init=dense_kernel_init, name=name)(x)
+
+
+def flatten(x: jax.Array) -> jax.Array:
+    return x.reshape((x.shape[0], -1))

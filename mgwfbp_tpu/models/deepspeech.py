@@ -1,0 +1,158 @@
+"""DeepSpeech-style CTC acoustic model (the reference's 'lstman4' workload).
+
+Parity target: reference models/lstm_models.py:148-321 — `MaskConv` (:45-72,
+two 2-D convs over (time, freq) with hardtanh and padding masks), `BatchRNN`
+(:83-105, sequence-wise BatchNorm + bidirectional RNN with summed directions),
+`Lookahead` (:108-145, context conv for unidirectional mode), `SequenceWise`
+(:21-42, time-flattened BatchNorm before the classifier); factory
+models/lstman4.py:8-33. Loss is CTC — warp-ctc in the reference
+(dl_trainer.py:214-215), `optax.ctc_loss` here (pure XLA, SURVEY.md §2.9).
+
+TPU re-design notes: NHWC convs on (B, T, F, 1) spectrograms; fixed padded T
+with explicit length masking (no pack_padded_sequence — static shapes for
+XLA); bidirectional layers via flax.linen.Bidirectional over lax.scan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def hardtanh_0_20(x: jax.Array) -> jax.Array:
+    return jnp.clip(x, 0.0, 20.0)
+
+
+def conv_out_length(lengths: jax.Array, kernel: int, stride: int, pad: int) -> jax.Array:
+    """Output time-length of a VALID-with-explicit-pad conv (reference
+    MaskConv recomputes output lengths the same way, lstm_models.py:252-262)."""
+    return (lengths + 2 * pad - kernel) // stride + 1
+
+
+def length_mask(lengths: jax.Array, max_len: int) -> jax.Array:
+    """(B,) -> (B, max_len) boolean validity mask."""
+    return jnp.arange(max_len)[None, :] < lengths[:, None]
+
+
+class MaskConv(nn.Module):
+    """Two conv+BN+hardtanh stages over (time, freq); activations at padded
+    time steps are zeroed after each stage (reference lstm_models.py:45-72)."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array, lengths: jax.Array, train: bool = True):
+        # x: (B, T, F, 1); lengths: (B,) valid time steps.
+        # Reference geometry (lstm_models.py conv stack): kernels 41/21 with
+        # stride 2 act on the FREQUENCY axis (161 -> 81 -> 41), kernel 11
+        # with strides 2 then 1 acts on TIME — so rnn feature size is 41*32.
+        def stage(x, lengths, features, kt, kf, st, sf):
+            pt, pf = kt // 2, kf // 2
+            x = nn.Conv(
+                features, (kt, kf), (st, sf),
+                padding=((pt, pt), (pf, pf)), use_bias=False,
+            )(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = hardtanh_0_20(x)
+            lengths = conv_out_length(lengths, kt, st, pt)
+            mask = length_mask(lengths, x.shape[1])
+            return x * mask[:, :, None, None], lengths
+
+        x, lengths = stage(x, lengths, 32, 11, 41, 2, 2)
+        x, lengths = stage(x, lengths, 32, 11, 21, 1, 2)
+        return x, lengths
+
+
+class BatchRNN(nn.Module):
+    """Sequence-wise BatchNorm + bidirectional LSTM with summed directions
+    (reference lstm_models.py:83-105)."""
+
+    hidden_size: int
+    batch_norm: bool = True
+    bidirectional: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, lengths: jax.Array, train: bool = True):
+        # x: (B, T, H)
+        if self.batch_norm:
+            # SequenceWise BN: normalize over (B*T) per feature
+            # (reference lstm_models.py:21-42)
+            b, t, h = x.shape
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(
+                x.reshape(b * t, h)
+            ).reshape(b, t, h)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="fwd")
+        if self.bidirectional:
+            bwd = nn.RNN(
+                nn.OptimizedLSTMCell(self.hidden_size), reverse=True,
+                keep_order=True, name="bwd",
+            )
+            y = fwd(x, seq_lengths=lengths) + bwd(x, seq_lengths=lengths)
+        else:
+            y = fwd(x, seq_lengths=lengths)
+        return y
+
+
+class Lookahead(nn.Module):
+    """Causal context convolution for unidirectional models (reference
+    lstm_models.py:108-145): each step sees `context` future frames."""
+
+    context: int = 20
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, h = x.shape
+        # depthwise conv over time with right-context window
+        pad = jnp.pad(x, ((0, 0), (0, self.context), (0, 0)))
+        w = self.param(
+            "weight", nn.initializers.lecun_normal(), (self.context + 1, h)
+        )
+        idx = jnp.arange(t)[:, None] + jnp.arange(self.context + 1)[None, :]
+        windows = pad[:, idx, :]  # (B, T, context+1, H)
+        return nn.relu(jnp.einsum("btch,ch->bth", windows, w))
+
+
+class DeepSpeech(nn.Module):
+    """conv stack + nb_layers x BatchRNN + SequenceWise BN + classifier
+    (reference lstm_models.py:148-321; defaults from models/lstman4.py:8-33:
+    LSTM, hidden 800, 5 layers, bidirectional)."""
+
+    num_classes: int = 29
+    hidden_size: int = 800
+    num_layers: int = 5
+    bidirectional: bool = True
+    sample_rate: int = 16000
+    window_size: float = 0.02
+
+    @nn.compact
+    def __call__(
+        self,
+        spect: jax.Array,  # (B, T, F) log-spectrogram, F = 161 for 16kHz/20ms
+        lengths: Optional[jax.Array] = None,  # (B,) valid frames
+        train: bool = True,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Returns (logits (B, T', num_classes), output_lengths (B,))."""
+        b, t, f = spect.shape
+        if lengths is None:
+            lengths = jnp.full((b,), t, dtype=jnp.int32)
+        x = spect[..., None]  # (B, T, F, 1)
+        x, lengths = MaskConv()(x, lengths, train)
+        # collapse (freq, channels) into features: (B, T', F'*32)
+        bb, tt, ff, cc = x.shape
+        x = x.reshape(bb, tt, ff * cc)
+        for i in range(self.num_layers):
+            x = BatchRNN(
+                self.hidden_size,
+                batch_norm=(i != 0),
+                bidirectional=self.bidirectional,
+                name=f"rnn_{i}",
+            )(x, lengths, train)
+        if not self.bidirectional:
+            x = Lookahead()(x)
+        bb, tt, hh = x.shape
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(
+            x.reshape(bb * tt, hh)
+        ).reshape(bb, tt, hh)
+        logits = nn.Dense(self.num_classes, use_bias=False)(x)
+        return logits, lengths

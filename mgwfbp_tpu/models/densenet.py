@@ -1,0 +1,108 @@
+"""DenseNets: DenseNet-BC 100-12 for CIFAR and DenseNet-121/161/201 for
+ImageNet.
+
+Parity targets: reference models/densenet.py:99-101 (CIFAR DenseNet-BC) and
+the torchvision densenet121/161/201 dispatch (dl_trainer.py:97-102).
+NHWC / Flax. Dense connectivity is expressed by channel concatenation, which
+XLA fuses with the following BN/conv.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import (
+    avg_pool,
+    classifier_head,
+    conv_kernel_init,
+    global_avg_pool,
+    max_pool,
+)
+
+
+class DenseLayer(nn.Module):
+    """Bottleneck dense layer: BN-ReLU-Conv1x1(4k) -> BN-ReLU-Conv3x3(k)."""
+
+    growth_rate: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        y = nn.relu(y)
+        y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                    kernel_init=conv_kernel_init)(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.growth_rate, (3, 3), padding="SAME", use_bias=False,
+                    kernel_init=conv_kernel_init)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    """Compression transition: BN-ReLU-Conv1x1(theta*C) + 2x2 avgpool."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=conv_kernel_init)(x)
+        return avg_pool(x)
+
+
+class DenseNet(nn.Module):
+    block_config: Sequence[int]
+    growth_rate: int = 32
+    num_init_features: int = 64
+    compression: float = 0.5
+    num_classes: int = 1000
+    imagenet_stem: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        if self.imagenet_stem:
+            x = nn.Conv(self.num_init_features, (7, 7), (2, 2), padding="SAME",
+                        use_bias=False, kernel_init=conv_kernel_init)(x)
+            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+            x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        else:
+            x = nn.Conv(self.num_init_features, (3, 3), padding="SAME",
+                        use_bias=False, kernel_init=conv_kernel_init)(x)
+        for bi, nlayers in enumerate(self.block_config):
+            for _ in range(nlayers):
+                x = DenseLayer(self.growth_rate)(x, train)
+            if bi != len(self.block_config) - 1:
+                x = Transition(int(x.shape[-1] * self.compression))(x, train)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = global_avg_pool(x)
+        return classifier_head(x, self.num_classes)
+
+
+def densenet_bc_100_12(num_classes: int = 10) -> DenseNet:
+    """CIFAR DenseNet-BC depth 100, growth 12 (reference models/densenet.py:
+    99-101): 3 blocks of (100-4)/6 = 16 bottleneck layers each."""
+    return DenseNet(
+        block_config=(16, 16, 16), growth_rate=12, num_init_features=24,
+        num_classes=num_classes, imagenet_stem=False,
+    )
+
+
+_IMAGENET_CONFIGS = {
+    121: ((6, 12, 24, 16), 32, 64),
+    161: ((6, 12, 36, 24), 48, 96),
+    201: ((6, 12, 48, 32), 32, 64),
+}
+
+
+def imagenet_densenet(depth: int, num_classes: int = 1000) -> DenseNet:
+    cfg, growth, init = _IMAGENET_CONFIGS[depth]
+    return DenseNet(
+        block_config=cfg, growth_rate=growth, num_init_features=init,
+        num_classes=num_classes,
+    )

@@ -1,0 +1,100 @@
+"""GoogLeNet (Inception v1) with auxiliary classifiers.
+
+Parity target: reference models/googlenet.py:53-233 (inception blocks with aux
+logits). NHWC / Flax. In training mode the module returns
+(logits, aux1_logits, aux2_logits); the trainer combines them with the classic
+0.3 aux weight. Eval returns logits only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import (
+    ConvBN,
+    avg_pool,
+    classifier_head,
+    flatten,
+    global_avg_pool,
+    max_pool,
+)
+
+
+class Inception(nn.Module):
+    """The 4-branch inception module: 1x1 / 1x1-3x3 / 1x1-5x5 / pool-1x1."""
+
+    b1: int
+    b2_reduce: int
+    b2: int
+    b3_reduce: int
+    b3: int
+    b4: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        y1 = ConvBN(self.b1, (1, 1))(x, train)
+        y2 = ConvBN(self.b2_reduce, (1, 1))(x, train)
+        y2 = ConvBN(self.b2, (3, 3))(y2, train)
+        y3 = ConvBN(self.b3_reduce, (1, 1))(x, train)
+        y3 = ConvBN(self.b3, (5, 5))(y3, train)
+        y4 = max_pool(x, (3, 3), (1, 1), padding="SAME")
+        y4 = ConvBN(self.b4, (1, 1))(y4, train)
+        return jnp.concatenate([y1, y2, y3, y4], axis=-1)
+
+
+class AuxHead(nn.Module):
+    """Auxiliary classifier: 5x5/3 avgpool -> 1x1 conv(128) -> fc1024 -> fc."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = avg_pool(x, (5, 5), (3, 3))
+        x = ConvBN(128, (1, 1))(x, train)
+        x = flatten(x)
+        x = nn.relu(nn.Dense(1024)(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    aux_logits: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True):
+        x = ConvBN(64, (7, 7), (2, 2))(x, train)
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = ConvBN(64, (1, 1))(x, train)
+        x = ConvBN(192, (3, 3))(x, train)
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = Inception(64, 96, 128, 16, 32, 32)(x, train)   # 3a -> 256
+        x = Inception(128, 128, 192, 32, 96, 64)(x, train)  # 3b -> 480
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = Inception(192, 96, 208, 16, 48, 64)(x, train)   # 4a -> 512
+        # Aux params are created unconditionally so the variable tree has the
+        # same structure whichever mode init ran in; only the *return* is
+        # gated on train.
+        aux1 = None
+        if self.aux_logits:
+            aux1 = AuxHead(self.num_classes, name="aux1")(x, train)
+        x = Inception(160, 112, 224, 24, 64, 64)(x, train)  # 4b
+        x = Inception(128, 128, 256, 24, 64, 64)(x, train)  # 4c
+        x = Inception(112, 144, 288, 32, 64, 64)(x, train)  # 4d -> 528
+        aux2 = None
+        if self.aux_logits:
+            aux2 = AuxHead(self.num_classes, name="aux2")(x, train)
+        x = Inception(256, 160, 320, 32, 128, 128)(x, train)  # 4e -> 832
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = Inception(256, 160, 320, 32, 128, 128)(x, train)  # 5a
+        x = Inception(384, 192, 384, 48, 128, 128)(x, train)  # 5b -> 1024
+        x = global_avg_pool(x)
+        x = nn.Dropout(0.4, deterministic=not train)(x)
+        logits = classifier_head(x, self.num_classes)
+        if self.aux_logits and train:
+            return logits, aux1, aux2
+        return logits

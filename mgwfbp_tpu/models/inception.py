@@ -1,0 +1,281 @@
+"""Inception v3 and v4.
+
+Parity targets: reference models/inceptionv4.py:264-358 (InceptionV4) and the
+torchvision inception_v3 dispatch (dl_trainer.py:103-111, dnn='inceptionv3',
+299x299 inputs). NHWC / Flax; factorized 7x1/1x7 convs keep the MXU busy with
+large contractions instead of wide spatial kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import (
+    ConvBN,
+    avg_pool,
+    classifier_head,
+    flatten,
+    global_avg_pool,
+    max_pool,
+)
+
+
+def _concat(*xs):
+    return jnp.concatenate(list(xs), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Inception v3
+# ---------------------------------------------------------------------------
+
+
+class InceptionA3(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(64, (1, 1))(x, train)
+        b2 = ConvBN(48, (1, 1))(x, train)
+        b2 = ConvBN(64, (5, 5))(b2, train)
+        b3 = ConvBN(64, (1, 1))(x, train)
+        b3 = ConvBN(96, (3, 3))(b3, train)
+        b3 = ConvBN(96, (3, 3))(b3, train)
+        b4 = avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = ConvBN(self.pool_features, (1, 1))(b4, train)
+        return _concat(b1, b2, b3, b4)
+
+
+class InceptionB3(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = ConvBN(64, (1, 1))(x, train)
+        b2 = ConvBN(96, (3, 3))(b2, train)
+        b2 = ConvBN(96, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = max_pool(x, (3, 3), (2, 2))
+        return _concat(b1, b2, b3)
+
+
+class InceptionC3(nn.Module):
+    c7: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(192, (1, 1))(x, train)
+        b2 = ConvBN(self.c7, (1, 1))(x, train)
+        b2 = ConvBN(self.c7, (1, 7))(b2, train)
+        b2 = ConvBN(192, (7, 1))(b2, train)
+        b3 = ConvBN(self.c7, (1, 1))(x, train)
+        b3 = ConvBN(self.c7, (7, 1))(b3, train)
+        b3 = ConvBN(self.c7, (1, 7))(b3, train)
+        b3 = ConvBN(self.c7, (7, 1))(b3, train)
+        b3 = ConvBN(192, (1, 7))(b3, train)
+        b4 = avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = ConvBN(192, (1, 1))(b4, train)
+        return _concat(b1, b2, b3, b4)
+
+
+class InceptionD3(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(192, (1, 1))(x, train)
+        b1 = ConvBN(320, (3, 3), (2, 2), padding="VALID")(b1, train)
+        b2 = ConvBN(192, (1, 1))(x, train)
+        b2 = ConvBN(192, (1, 7))(b2, train)
+        b2 = ConvBN(192, (7, 1))(b2, train)
+        b2 = ConvBN(192, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = max_pool(x, (3, 3), (2, 2))
+        return _concat(b1, b2, b3)
+
+
+class InceptionE3(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(320, (1, 1))(x, train)
+        b2 = ConvBN(384, (1, 1))(x, train)
+        b2 = _concat(
+            ConvBN(384, (1, 3))(b2, train), ConvBN(384, (3, 1))(b2, train)
+        )
+        b3 = ConvBN(448, (1, 1))(x, train)
+        b3 = ConvBN(384, (3, 3))(b3, train)
+        b3 = _concat(
+            ConvBN(384, (1, 3))(b3, train), ConvBN(384, (3, 1))(b3, train)
+        )
+        b4 = avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = ConvBN(192, (1, 1))(b4, train)
+        return _concat(b1, b2, b3, b4)
+
+
+class InceptionV3Aux(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = avg_pool(x, (5, 5), (3, 3))
+        x = ConvBN(128, (1, 1))(x, train)
+        x = ConvBN(768, (5, 5), padding="VALID")(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class InceptionV3(nn.Module):
+    """299x299 Inception v3 with auxiliary head (train mode returns
+    (logits, aux))."""
+
+    num_classes: int = 1000
+    aux_logits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = ConvBN(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = ConvBN(32, (3, 3), padding="VALID")(x, train)
+        x = ConvBN(64, (3, 3))(x, train)
+        x = max_pool(x, (3, 3), (2, 2))
+        x = ConvBN(80, (1, 1))(x, train)
+        x = ConvBN(192, (3, 3), padding="VALID")(x, train)
+        x = max_pool(x, (3, 3), (2, 2))
+        x = InceptionA3(32)(x, train)
+        x = InceptionA3(64)(x, train)
+        x = InceptionA3(64)(x, train)
+        x = InceptionB3()(x, train)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC3(c7)(x, train)
+        # Created unconditionally so param structure is mode-independent.
+        aux = None
+        if self.aux_logits:
+            aux = InceptionV3Aux(self.num_classes, name="aux")(x, train)
+        x = InceptionD3()(x, train)
+        x = InceptionE3()(x, train)
+        x = InceptionE3()(x, train)
+        x = global_avg_pool(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        logits = classifier_head(x, self.num_classes)
+        if self.aux_logits and train:
+            return logits, aux
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Inception v4 (reference models/inceptionv4.py:264-358)
+# ---------------------------------------------------------------------------
+
+
+class StemV4(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = ConvBN(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = ConvBN(32, (3, 3), padding="VALID")(x, train)
+        x = ConvBN(64, (3, 3))(x, train)
+        x = _concat(
+            max_pool(x, (3, 3), (2, 2)),
+            ConvBN(96, (3, 3), (2, 2), padding="VALID")(x, train),
+        )
+        a = ConvBN(64, (1, 1))(x, train)
+        a = ConvBN(96, (3, 3), padding="VALID")(a, train)
+        b = ConvBN(64, (1, 1))(x, train)
+        b = ConvBN(64, (1, 7))(b, train)
+        b = ConvBN(64, (7, 1))(b, train)
+        b = ConvBN(96, (3, 3), padding="VALID")(b, train)
+        x = _concat(a, b)
+        return _concat(
+            ConvBN(192, (3, 3), (2, 2), padding="VALID")(x, train),
+            max_pool(x, (3, 3), (2, 2)),
+        )
+
+
+class InceptionA4(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(96, (1, 1))(x, train)
+        b2 = ConvBN(64, (1, 1))(x, train)
+        b2 = ConvBN(96, (3, 3))(b2, train)
+        b3 = ConvBN(64, (1, 1))(x, train)
+        b3 = ConvBN(96, (3, 3))(b3, train)
+        b3 = ConvBN(96, (3, 3))(b3, train)
+        b4 = avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = ConvBN(96, (1, 1))(b4, train)
+        return _concat(b1, b2, b3, b4)
+
+
+class ReductionA4(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = ConvBN(192, (1, 1))(x, train)
+        b2 = ConvBN(224, (3, 3))(b2, train)
+        b2 = ConvBN(256, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = max_pool(x, (3, 3), (2, 2))
+        return _concat(b1, b2, b3)
+
+
+class InceptionB4(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(384, (1, 1))(x, train)
+        b2 = ConvBN(192, (1, 1))(x, train)
+        b2 = ConvBN(224, (1, 7))(b2, train)
+        b2 = ConvBN(256, (7, 1))(b2, train)
+        b3 = ConvBN(192, (1, 1))(x, train)
+        b3 = ConvBN(192, (7, 1))(b3, train)
+        b3 = ConvBN(224, (1, 7))(b3, train)
+        b3 = ConvBN(224, (7, 1))(b3, train)
+        b3 = ConvBN(256, (1, 7))(b3, train)
+        b4 = avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = ConvBN(128, (1, 1))(b4, train)
+        return _concat(b1, b2, b3, b4)
+
+
+class ReductionB4(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(192, (1, 1))(x, train)
+        b1 = ConvBN(192, (3, 3), (2, 2), padding="VALID")(b1, train)
+        b2 = ConvBN(256, (1, 1))(x, train)
+        b2 = ConvBN(256, (1, 7))(b2, train)
+        b2 = ConvBN(320, (7, 1))(b2, train)
+        b2 = ConvBN(320, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = max_pool(x, (3, 3), (2, 2))
+        return _concat(b1, b2, b3)
+
+
+class InceptionC4(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = ConvBN(256, (1, 1))(x, train)
+        b2 = ConvBN(384, (1, 1))(x, train)
+        b2 = _concat(
+            ConvBN(256, (1, 3))(b2, train), ConvBN(256, (3, 1))(b2, train)
+        )
+        b3 = ConvBN(384, (1, 1))(x, train)
+        b3 = ConvBN(448, (3, 1))(b3, train)
+        b3 = ConvBN(512, (1, 3))(b3, train)
+        b3 = _concat(
+            ConvBN(256, (1, 3))(b3, train), ConvBN(256, (3, 1))(b3, train)
+        )
+        b4 = avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = ConvBN(256, (1, 1))(b4, train)
+        return _concat(b1, b2, b3, b4)
+
+
+class InceptionV4(nn.Module):
+    """299x299 Inception v4 (reference models/inceptionv4.py:264-358):
+    stem + 4xA + ReductionA + 7xB + ReductionB + 3xC + head."""
+
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = StemV4()(x, train)
+        for _ in range(4):
+            x = InceptionA4()(x, train)
+        x = ReductionA4()(x, train)
+        for _ in range(7):
+            x = InceptionB4()(x, train)
+        x = ReductionB4()(x, train)
+        for _ in range(3):
+            x = InceptionC4()(x, train)
+        x = global_avg_pool(x)
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return classifier_head(x, self.num_classes)

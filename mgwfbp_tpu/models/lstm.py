@@ -1,0 +1,66 @@
+"""PTB word-level language model: 2-layer LSTM, 1500-d hidden.
+
+Parity target: reference models/lstm.py:5-47 (embedding 10000->1500, two
+stacked LSTM layers, dropout 0.65, linear decoder; `repackage_hidden` at
+:42-47 detaches the BPTT carry between windows). TPU re-design: time axis is
+scanned with `flax.linen.RNN` (lax.scan under jit — static shapes, no Python
+loop), carry is threaded through the train step as explicit state, and the
+detach is implicit because the carry crosses the jit boundary each window.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Carry = Any  # tuple over layers of LSTMCell carries ((c, h), ...)
+
+
+class PTBLSTM(nn.Module):
+    vocab_size: int = 10000
+    hidden_size: int = 1500
+    num_layers: int = 2
+    dropout: float = 0.65
+
+    def initial_carry(self, batch_size: int, dtype=jnp.float32) -> Carry:
+        """Zero carry for a fresh epoch (reference init_hidden)."""
+        shape = (batch_size, self.hidden_size)
+        return tuple(
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(self.num_layers)
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,  # (B, T) int32
+        carry: Optional[Carry] = None,
+        train: bool = True,
+    ) -> tuple[jax.Array, Carry]:
+        """Returns (logits (B, T, V), new_carry)."""
+        if carry is None:
+            carry = self.initial_carry(tokens.shape[0])
+        x = nn.Embed(self.vocab_size, self.hidden_size, name="embedding")(tokens)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        new_carry = []
+        for layer in range(self.num_layers):
+            rnn = nn.RNN(
+                nn.OptimizedLSTMCell(self.hidden_size),
+                return_carry=True,
+                name=f"lstm_{layer}",
+            )
+            c, x = rnn(x, initial_carry=carry[layer])
+            new_carry.append(c)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        logits = nn.Dense(self.vocab_size, name="decoder")(x)
+        return logits, tuple(new_carry)
+
+
+def repackage_carry(carry: Carry) -> Carry:
+    """Detach the BPTT carry (reference models/lstm.py:42-47). Under jit the
+    carry returned from a step is already a leaf array; stop_gradient makes
+    the intent explicit when composing windows inside one program."""
+    return jax.tree_util.tree_map(jax.lax.stop_gradient, carry)

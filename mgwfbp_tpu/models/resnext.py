@@ -1,0 +1,61 @@
+"""ResNeXt-29 for CIFAR (aggregated grouped-conv bottlenecks).
+
+Parity target: reference models/resnext.py:110-126 (`CifarResNeXt`, depth 29).
+NHWC / Flax; grouped convolution maps to `feature_group_count`, which XLA:TPU
+lowers to a single batched MXU contraction.
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import ConvBN, classifier_head, global_avg_pool
+
+
+class ResNeXtBlock(nn.Module):
+    """1x1 reduce -> 3x3 grouped -> 1x1 expand, with projection shortcut."""
+
+    features: int  # output width of the block
+    cardinality: int = 8
+    base_width: int = 64
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        # width of the grouped conv: D = C * base_width * (features / 256)
+        # (standard ResNeXt widening rule, keeps FLOPs comparable to ResNet)
+        d = self.cardinality * int(self.base_width * self.features / 256)
+        residual = x
+        y = ConvBN(d, (1, 1))(x, train)
+        y = ConvBN(d, (3, 3), (self.strides, self.strides),
+                   groups=self.cardinality)(y, train)
+        y = ConvBN(self.features, (1, 1), use_relu=False)(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features, (1, 1), (self.strides, self.strides),
+                use_relu=False, name="shortcut",
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class ResNeXt29(nn.Module):
+    """depth 29 = 3 stages x 3 blocks x 3 convs + stem/head (reference
+    models/resnext.py)."""
+
+    num_classes: int = 10
+    cardinality: int = 8
+    base_width: int = 64
+    widths: tuple[int, ...] = (256, 512, 1024)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = ConvBN(64, (3, 3))(x, train)
+        for stage, width in enumerate(self.widths):
+            for i in range(3):
+                strides = 2 if (stage > 0 and i == 0) else 1
+                x = ResNeXtBlock(
+                    width, self.cardinality, self.base_width, strides
+                )(x, train)
+        x = global_avg_pool(x)
+        return classifier_head(x, self.num_classes)

@@ -1,0 +1,103 @@
+"""Small dense/conv models: MnistNet, LeNet, FCN5Net, LinearRegression,
+Caffe-CIFAR.
+
+Parity targets: reference dl_trainer.py:65-82 (MnistNet), models/lenet.py:5-24,
+models/fcn.py:9-35 (FCN5Net, LinearRegression), models/caffe_cifar.py:10-59.
+Re-designed as Flax/NHWC modules (see models/common.py conventions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import (
+    dense_kernel_init,
+    flatten,
+    global_avg_pool,
+    local_response_norm,
+    max_pool,
+)
+
+
+class MnistNet(nn.Module):
+    """2-conv/2-fc MNIST net (reference dl_trainer.py:65-82): conv10@5x5 ->
+    pool -> conv20@5x5 -> dropout -> pool -> fc50 -> dropout -> fc10."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.relu(max_pool(nn.Conv(10, (5, 5), padding="VALID")(x)))
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(max_pool(x))
+        x = flatten(x)
+        x = nn.relu(nn.Dense(50)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class LeNet(nn.Module):
+    """LeNet-5 (reference models/lenet.py:5-24): conv6@5x5/pool/conv16@5x5/
+    pool/fc120/fc84/fc{num_classes}."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.relu(nn.Conv(6, (5, 5), padding="SAME")(x))
+        x = max_pool(x)
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID")(x))
+        x = max_pool(x)
+        x = flatten(x)
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class FCN5Net(nn.Module):
+    """5-layer fully-connected net (reference models/fcn.py:9-26)."""
+
+    num_classes: int = 10
+    hidden: int = 4096
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = flatten(x)
+        for _ in range(3):
+            x = nn.relu(nn.Dense(self.hidden, kernel_init=dense_kernel_init)(x))
+        x = nn.relu(nn.Dense(1024, kernel_init=dense_kernel_init)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class LinearRegression(nn.Module):
+    """Single linear layer (reference models/fcn.py:28-35, dnn='lr')."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        return nn.Dense(self.num_classes)(flatten(x))
+
+
+class CaffeCifar(nn.Module):
+    """Caffe cifar10-quick style net (reference models/caffe_cifar.py:10-59):
+    3x [conv5x5 + pool3x3s2] with LRN after the first two stages, then fc."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME")(x))
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = local_response_norm(x, size=3)
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME")(x))
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = local_response_norm(x, size=3)
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME")(x))
+        x = max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = flatten(x)
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,67 @@
+"""VGG 11/13/16/19 for CIFAR (BN variant) and VGG-16 for ImageNet ('vgg16i').
+
+Parity targets: reference models/vgg.py:14-38 (CIFAR VGG with a single
+512->num_classes classifier) and the torchvision vgg16 the reference uses for
+ImageNet (dl_trainer.py:121-122, dnn='vgg16i'). NHWC / Flax.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+from flax import linen as nn
+
+from mgwfbp_tpu.models.common import ConvBN, conv_kernel_init, flatten, max_pool
+
+# Layer configs: ints are conv widths, 'M' is 2x2 maxpool (classic VGG tables).
+CFGS: dict[str, Sequence[Union[int, str]]] = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGGCifar(nn.Module):
+    """CIFAR VGG with BatchNorm and a single linear classifier on the 512-d
+    pooled feature (reference models/vgg.py:14-38)."""
+
+    cfg: str = "vgg16"
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        for item in CFGS[self.cfg]:
+            if item == "M":
+                x = max_pool(x)
+            else:
+                x = ConvBN(int(item), (3, 3))(x, train)
+        x = flatten(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class VGGImageNet(nn.Module):
+    """ImageNet VGG (torchvision-style: plain convs, 3 fc layers with dropout;
+    reference uses torchvision vgg16 at dl_trainer.py:121-122)."""
+
+    cfg: str = "vgg16"
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        for item in CFGS[self.cfg]:
+            if item == "M":
+                x = max_pool(x)
+            else:
+                x = nn.relu(
+                    nn.Conv(int(item), (3, 3), kernel_init=conv_kernel_init)(x)
+                )
+        x = flatten(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

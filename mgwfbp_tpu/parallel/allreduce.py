@@ -16,6 +16,7 @@ No handles, no flags, no explicit synchronize: dataflow is the schedule.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Optional, Sequence
 
 import jax
@@ -29,22 +30,50 @@ from mgwfbp_tpu.parallel.solver import (
     MergeSchedule,
     build_schedule,
     check_unique,
+    simulate_groups,
 )
 
 
-def arrival_order(num_leaves: int, perm: Optional[Sequence[int]] = None) -> list[int]:
+_DIGITS = re.compile(r"(\d+)")
+
+
+def _natural_key(name: str) -> tuple:
+    """Digit-aware sort key: 'Block_10' sorts after 'Block_2'."""
+    return tuple(int(t) if t.isdigit() else t for t in _DIGITS.split(name))
+
+
+def forward_order(names: Sequence[str]) -> list[int]:
+    """Indices of `names` in natural (digit-aware) path order.
+
+    Flax auto-names sibling modules Type_0..Type_N, but pytree flattening
+    sorts dict keys LEXICOGRAPHICALLY (Block_0, Block_1, Block_10, Block_11,
+    ..., Block_2, ...), which scrambles definition order for any model with
+    10+ sibling blocks. Natural ordering restores the definition (≈forward)
+    order the merge schedule needs.
+    """
+    return sorted(range(len(names)), key=lambda i: _natural_key(names[i]))
+
+
+def arrival_order(
+    num_leaves: int,
+    perm: Optional[Sequence[int]] = None,
+    names: Optional[Sequence[str]] = None,
+) -> list[int]:
     """Default gradient-arrival permutation over pytree leaves.
 
-    `jax.tree_util.tree_leaves` of a Flax param tree enumerates modules in
-    definition (≈forward) order, so arrival order is its reverse — gradients
-    of the last forward layer exist first (the reference measures the true
-    order with profiling hooks, profiling.py:31-48; a measured permutation can
-    be passed instead).
+    Arrival order is the reverse of forward order — gradients of the last
+    forward layer exist first. The reference measures the true order with
+    profiling hooks (profiling.py:31-48); pass that as `perm` when available.
+    Otherwise, with `names` (leaf key paths) the forward order is recovered by
+    natural-sorting the paths; with neither, leaves are assumed already in
+    forward order.
     """
     if perm is not None:
         if sorted(perm) != list(range(num_leaves)):
             raise ValueError("perm must be a permutation of range(num_leaves)")
         return list(perm)
+    if names is not None:
+        return list(reversed(forward_order(names)))
     return list(reversed(range(num_leaves)))
 
 
@@ -133,14 +162,14 @@ def make_merged_allreduce(
     """
     leaves = jax.tree_util.tree_leaves(params_or_shapes)
     n = len(leaves)
-    p = arrival_order(n, perm)
-    arr = [leaves[j] for j in p]
     if names is None:
         paths = jax.tree_util.tree_flatten_with_path(params_or_shapes)[0]
         all_names = [jax.tree_util.keystr(kp) for kp, _ in paths]
-        names_arr = [all_names[j] for j in p]
     else:
-        names_arr = [names[j] for j in p]
+        all_names = list(names)
+    p = arrival_order(n, perm, names=all_names)
+    arr = [leaves[j] for j in p]
+    names_arr = [all_names[j] for j in p]
     check_unique(names_arr)
     def _numel(l):
         sz = 1
@@ -160,6 +189,21 @@ def make_merged_allreduce(
         specs, tb, policy=policy, cost_model=cost_model, threshold=threshold
     )
     layout = build_layout(arr, schedule.groups)
+    if layout.groups != schedule.groups:
+        # build_layout split one or more groups at dtype boundaries; each
+        # split adds a real collective (and its alpha), so re-simulate the
+        # predictions on the groups actually issued.
+        schedule = dataclasses.replace(schedule, groups=layout.groups)
+        if tb is not None and cost_model is not None:
+            total, nonoverlap, comm = simulate_groups(
+                layout.groups, [s.nbytes for s in specs], tb, cost_model.predict
+            )
+            schedule = dataclasses.replace(
+                schedule,
+                predicted_total_time=total,
+                predicted_nonoverlap_time=nonoverlap,
+                predicted_comm_time=comm,
+            )
     return MergedAllreduce(
         schedule=schedule,
         layout=layout,

@@ -69,9 +69,15 @@ def fit_alpha_beta(sizes_bytes: Sequence[float], times_s: Sequence[float]) -> Al
     if denom == 0.0:
         raise ValueError("all sizes identical; cannot fit beta")
     beta = float(((x - xm) * (y - ym)).sum() / denom)
+    if beta < 0.0:
+        # Noisy samples with time decreasing in size: best nonnegative-slope
+        # fit is the constant model at the mean.
+        return AlphaBeta(alpha=max(float(ym), 0.0), beta=0.0)
     alpha = float(ym - beta * xm)
-    beta = max(beta, 0.0)
-    alpha = max(alpha, 0.0)
+    if alpha < 0.0:
+        # Refit through the origin under the alpha >= 0 constraint.
+        beta = max(float((x * y).sum() / (x * x).sum()), 0.0)
+        alpha = 0.0
     return AlphaBeta(alpha=alpha, beta=beta)
 
 

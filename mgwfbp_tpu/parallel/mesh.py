@@ -40,10 +40,26 @@ def init_distributed(
     process_id: Optional[int] = None,
 ) -> None:
     """Multi-host bootstrap (reference: `hvd.init()` / mpirun). No-op when
-    single-process or when jax.distributed is already initialized."""
+    single-process or when jax.distributed is already initialized.
+
+    Passing coordinator_address/process_id signals an explicit multi-host
+    launch; silently skipping initialization there would leave each host
+    training unsynchronized, so a missing worker count is an error instead.
+    """
+    explicit = coordinator_address is not None or process_id is not None
     if num_processes is None:
-        num_processes = int(os.environ.get("MGWFBP_NUM_PROCESSES", "1"))
-    if num_processes <= 1:
+        env = os.environ.get("MGWFBP_NUM_PROCESSES")
+        if env is not None:
+            num_processes = int(env)
+        elif explicit:
+            raise ValueError(
+                "init_distributed: coordinator_address/process_id given but "
+                "num_processes unknown; pass num_processes or set "
+                "MGWFBP_NUM_PROCESSES"
+            )
+        else:
+            return
+    if num_processes <= 1 and not explicit:
         return
     try:
         jax.distributed.initialize(

@@ -74,7 +74,7 @@ class MergeSchedule:
         return [[self.layer_names[i] for i in g] for g in self.groups]
 
 
-def _simulate(
+def simulate_groups(
     groups: Sequence[Sequence[int]],
     sizes_bytes: Sequence[int],
     tb: Sequence[float],
@@ -238,7 +238,7 @@ def build_schedule(
         raise ValueError(f"unknown policy {policy!r}")
 
     if tb is not None and cost_model is not None and len(layers):
-        total, nonoverlap, comm = _simulate(groups, nbytes, tb, cost_model.predict)
+        total, nonoverlap, comm = simulate_groups(groups, nbytes, tb, cost_model.predict)
     else:
         total = nonoverlap = comm = float("nan")
     return MergeSchedule(

@@ -125,3 +125,51 @@ def test_merged_psum_multi_axis():
     stacked = jax.tree.map(lambda x: jnp.ones((4, 2) + x.shape), tree)
     out = jax.jit(f)(stacked)
     np.testing.assert_allclose(np.asarray(out["w"]), 8.0)
+
+
+def test_forward_order_natural_sort():
+    # Lexicographic pytree key order scrambles Block_10 before Block_2;
+    # forward_order must restore numeric order.
+    from mgwfbp_tpu.parallel.allreduce import arrival_order, forward_order
+
+    names = [f"Block_{i}" for i in (0, 1, 10, 11, 2, 3)]  # lexicographic
+    fwd = forward_order(names)
+    assert [names[i] for i in fwd] == [
+        "Block_0", "Block_1", "Block_2", "Block_3", "Block_10", "Block_11"
+    ]
+    arr = arrival_order(len(names), names=names)
+    assert [names[i] for i in arr] == [
+        "Block_11", "Block_10", "Block_3", "Block_2", "Block_1", "Block_0"
+    ]
+
+
+def test_make_merged_allreduce_uses_natural_order():
+    import jax
+    import jax.numpy as jnp
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+
+    # 12 sibling keys force the Block_10/Block_2 lexicographic trap.
+    tree = {f"Block_{i}": jax.ShapeDtypeStruct((2,), jnp.float32) for i in range(12)}
+    mar = make_merged_allreduce(tree, axis_name="data", policy="wfbp")
+    names = mar.schedule.layer_names
+    assert "Block_11" in names[0] and "Block_0" in names[-1]
+
+
+def test_dtype_split_updates_schedule_predictions():
+    import jax
+    import jax.numpy as jnp
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+    from mgwfbp_tpu.parallel.costmodel import AlphaBeta
+
+    # One solver group crossing a dtype boundary must be split, and the
+    # schedule's groups/predictions must describe the post-split collectives.
+    tree = {
+        "a": jax.ShapeDtypeStruct((1000,), jnp.float32),
+        "b": jax.ShapeDtypeStruct((1000,), jnp.bfloat16),
+    }
+    cm = AlphaBeta(alpha=1.0, beta=0.0)  # pure-startup cost: count collectives
+    mar = make_merged_allreduce(
+        tree, axis_name="data", policy="single", tb=[1e-6, 1e-6], cost_model=cm
+    )
+    assert mar.schedule.num_groups == mar.layout.num_groups == 2
+    assert mar.schedule.predicted_comm_time == 2.0  # one alpha per real group

@@ -82,3 +82,21 @@ def test_profile_roundtrip(tmp_path):
     )
     m2 = load_profile(str(p2))
     assert isinstance(m2, TwoLevelAlphaBeta) and m2.dcn_size == 4
+
+
+def test_fit_negative_beta_falls_back_to_constant_model():
+    from mgwfbp_tpu.parallel.costmodel import fit_alpha_beta
+
+    # time decreasing in size: nonnegative-slope best fit is the mean
+    ab = fit_alpha_beta([1e6, 2e6, 3e6], [5.0, 4.0, 3.0])
+    assert ab.beta == 0.0
+    assert abs(ab.alpha - 4.0) < 1e-9  # mean, not ym + |beta|*xm
+
+
+def test_init_distributed_requires_num_processes_when_explicit():
+    import pytest
+
+    from mgwfbp_tpu.parallel.mesh import init_distributed
+
+    with pytest.raises(ValueError, match="num_processes"):
+        init_distributed(coordinator_address="host0:1234", process_id=0)
