@@ -1,0 +1,180 @@
+"""Data subsystem: dataset dispatch + sharded loading.
+
+`data_prepare` is the analogue of the reference's per-dataset prepare methods
+and dispatcher (reference dl_trainer.py:317-539): it resolves a dataset name
+to sharded train/val loaders. Real files under `data_dir` are used when
+present; otherwise a deterministic synthetic twin with identical
+shapes/cardinalities is served (no-egress container — see data/datasets.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from mgwfbp_tpu.data.datasets import (
+    CIFAR_MEAN,
+    CIFAR_STD,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    MNIST_MEAN,
+    MNIST_STD,
+    load_cifar10,
+    load_imagenet_hdf5,
+    load_mnist,
+    synthetic_images,
+)
+from mgwfbp_tpu.data.loader import (
+    ArrayDataset,
+    ShardedLoader,
+    infinite_batches,
+    normalize_images,
+)
+from mgwfbp_tpu.data.sharding import ShardInfo
+
+# Synthetic sizes: big enough for stable throughput measurement and smoke
+# convergence, small enough to build instantly.
+_SYNTH_TRAIN = {"mnist": 4096, "cifar10": 4096, "imagenet": 512, "ptb": 512}
+_SYNTH_VAL = {"mnist": 512, "cifar10": 512, "imagenet": 128, "ptb": 64}
+
+
+@dataclasses.dataclass
+class DataBundle:
+    train: ShardedLoader
+    val: ShardedLoader
+    num_classes: int
+    synthetic: bool
+    # batches per epoch over the GLOBAL batch (reference dl_trainer.py:539
+    # divides by batch_size * nworkers)
+    num_batches_per_epoch: int
+
+
+def data_prepare(
+    dataset: str,
+    data_dir: str = "./data",
+    batch_size: int = 32,
+    shard: ShardInfo = ShardInfo(),
+    seed: int = 0,
+    image_hw: Optional[tuple[int, int]] = None,
+    synthetic: Optional[bool] = None,
+) -> DataBundle:
+    """Build sharded train/val loaders for a dataset name.
+
+    batch_size is PER PROCESS (weak scaling, reference dl_trainer.py:153-156).
+    `synthetic=True` forces the synthetic twin; None auto-detects files.
+    `image_hw` overrides the image size (inceptions need 299x299).
+    """
+    name = dataset.lower()
+    if name in ("mnist", "cifar10", "imagenet"):
+        hw_default = {"mnist": (28, 28), "cifar10": (32, 32), "imagenet": (224, 224)}
+        h, w = image_hw or hw_default[name]
+        c = 1 if name == "mnist" else 3
+        mean, std = {
+            "mnist": (MNIST_MEAN, MNIST_STD),
+            "cifar10": (CIFAR_MEAN, CIFAR_STD),
+            "imagenet": (IMAGENET_MEAN, IMAGENET_STD),
+        }[name]
+        train = val = None
+        if not synthetic:
+            loader_fn = {
+                "mnist": load_mnist,
+                "cifar10": load_cifar10,
+                "imagenet": load_imagenet_hdf5,
+            }[name]
+            train = loader_fn(data_dir, "train")
+            val = loader_fn(data_dir, "val" if name == "imagenet" else "test")
+        is_synth = train is None or val is None
+        if is_synth:
+            if synthetic is False:
+                raise FileNotFoundError(
+                    f"real {name} data not found under {data_dir!r}"
+                )
+            nc = 1000 if name == "imagenet" else 10
+            train = synthetic_images(_SYNTH_TRAIN[name], (h, w, c), nc, seed)
+            val = synthetic_images(_SYNTH_VAL[name], (h, w, c), nc, seed + 1)
+        else:
+            real_hw = tuple(train.data.shape[1:3])
+            if image_hw is not None and real_hw != tuple(image_hw):
+                raise ValueError(
+                    f"requested image_hw {image_hw} but real {name} files "
+                    f"under {data_dir!r} store {real_hw} images; rebuild the "
+                    "dataset at the requested size (scripts/create_hdf5)"
+                )
+        transform = normalize_images(mean, std)
+        train_loader = ShardedLoader(
+            train, batch_size, shard, shuffle=True, seed=seed, transform=transform
+        )
+        val_loader = ShardedLoader(
+            val, batch_size, shard, shuffle=False, seed=seed,
+            drop_last=False, transform=transform,
+        )
+        return DataBundle(
+            train=train_loader,
+            val=val_loader,
+            num_classes=train.num_classes,
+            synthetic=is_synth,
+            # per-rank loader length already divides by nranks, so this is
+            # dataset_size / (batch_size * nranks) — the reference's formula
+            num_batches_per_epoch=len(train_loader),
+        )
+    if name == "ptb":
+        from mgwfbp_tpu.data.ptb import (
+            NUM_STEPS,
+            VOCAB_SIZE,
+            carry_layout,
+            load_ptb_stream,
+            synthetic_ptb_stream,
+        )
+
+        streams = None
+        if not synthetic:
+            streams = (load_ptb_stream(data_dir, "train"),
+                       load_ptb_stream(data_dir, "valid"))
+            if streams[0] is None or streams[1] is None:
+                streams = None
+        is_synth = streams is None
+        if is_synth:
+            if synthetic is False:
+                raise FileNotFoundError(f"PTB files not found under {data_dir!r}")
+            vocab_size = VOCAB_SIZE
+            train_stream = synthetic_ptb_stream(_SYNTH_TRAIN["ptb"], seed=seed)
+            val_stream = synthetic_ptb_stream(_SYNTH_VAL["ptb"], seed=seed + 1)
+        else:
+            (train_stream, vocab_size), (val_stream, _) = streams
+        # Stateful-BPTT layout: contiguous sub-streams per batch element and
+        # per rank (see ptb.carry_layout); NO shuffling, NO sample-sharding —
+        # the carry must see textually consecutive windows each step.
+        train = carry_layout(
+            train_stream, NUM_STEPS, batch_size, shard.rank, shard.nranks,
+            vocab_size,
+        )
+        val = carry_layout(
+            val_stream, NUM_STEPS, batch_size, shard.rank, shard.nranks,
+            vocab_size,
+        )
+        train_loader = ShardedLoader(train, batch_size, shuffle=False, seed=seed)
+        val_loader = ShardedLoader(val, batch_size, shuffle=False, seed=seed)
+        return DataBundle(
+            train=train_loader,
+            val=val_loader,
+            num_classes=vocab_size,
+            synthetic=is_synth,
+            num_batches_per_epoch=len(train_loader),
+        )
+    if name == "an4":
+        from mgwfbp_tpu.data.audio import an4_prepare
+
+        return an4_prepare(data_dir, batch_size, shard, seed, synthetic)
+    raise ValueError(f"unknown dataset {dataset!r}")
+
+
+__all__ = [
+    "ArrayDataset",
+    "DataBundle",
+    "ShardInfo",
+    "ShardedLoader",
+    "data_prepare",
+    "infinite_batches",
+]

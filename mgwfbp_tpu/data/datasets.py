@@ -1,0 +1,153 @@
+"""Dataset constructors: real files when present, deterministic synthetic
+fallback otherwise.
+
+The reference's data layer (SURVEY.md §2.8): torchvision CIFAR-10/MNIST
+downloads, an HDF5 single-file ImageNet (reference datasets.py:8-36 +
+scripts/create_hdf5.py), a PTB word-LM reader (ptb_reader.py), and the AN4
+audio pipeline. This container has no network egress, so every dataset has a
+synthetic twin with the exact shapes/dtypes/cardinalities of the real one —
+the benchmark path (throughput, scaling, schedule quality) is data-content
+agnostic; accuracy runs use the real files when mounted at data_dir.
+
+File formats understood:
+  mnist    — idx ubyte files (train-images-idx3-ubyte, ...) under data_dir
+  cifar10  — python-pickle batches (cifar-10-batches-py/) under data_dir
+  imagenet — single HDF5 with train_img/train_labels/val_img/val_labels
+             (reference datasets.py:14-18 layout)
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Optional
+
+import numpy as np
+
+from mgwfbp_tpu.data.loader import ArrayDataset
+
+CIFAR_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR_STD = (0.2470, 0.2435, 0.2616)
+MNIST_MEAN = (0.1307,)
+MNIST_STD = (0.3081,)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def synthetic_images(
+    n: int, hwc: tuple[int, int, int], num_classes: int, seed: int = 0
+) -> ArrayDataset:
+    """Deterministic fake image set with class-dependent means so that a
+    model can actually fit it (convergence smoke tests need learnable
+    signal, not pure noise)."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=n).astype(np.int32)
+    base = rng.randint(0, 256, size=(n,) + hwc)
+    # shift each image's intensity by its class so P(x|y) differs per class
+    # (float scaling keeps a nonzero gradient of shift w.r.t. class even for
+    # num_classes > 128, where integer division would collapse to 0)
+    shift = np.round(labels * (128.0 / max(num_classes - 1, 1))).astype(np.int64)
+    data = np.clip(base // 2 + shift[:, None, None, None], 0, 255).astype(np.uint8)
+    return ArrayDataset(data=data, labels=labels, num_classes=num_classes)
+
+
+# ---------------------------------------------------------------------------
+# MNIST (idx files)
+# ---------------------------------------------------------------------------
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def load_mnist(data_dir: str, split: str = "train") -> Optional[ArrayDataset]:
+    prefix = "train" if split == "train" else "t10k"
+    for suffix in ("", ".gz"):
+        img = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte{suffix}")
+        lbl = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte{suffix}")
+        if os.path.exists(img) and os.path.exists(lbl):
+            data = _read_idx(img)[..., None]  # (N, 28, 28, 1)
+            labels = _read_idx(lbl).astype(np.int32)
+            return ArrayDataset(data=data, labels=labels, num_classes=10)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 (pickle batches)
+# ---------------------------------------------------------------------------
+
+
+def load_cifar10(data_dir: str, split: str = "train") -> Optional[ArrayDataset]:
+    root = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(root):
+        return None
+    files = (
+        [f"data_batch_{i}" for i in range(1, 6)] if split == "train" else ["test_batch"]
+    )
+    xs, ys = [], []
+    for fn in files:
+        path = os.path.join(root, fn)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        xs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        ys.append(np.asarray(d[b"labels"], dtype=np.int32))
+    return ArrayDataset(
+        data=np.concatenate(xs), labels=np.concatenate(ys), num_classes=10
+    )
+
+
+# ---------------------------------------------------------------------------
+# ImageNet (single HDF5, reference datasets.py layout)
+# ---------------------------------------------------------------------------
+
+
+class HDF5ImageDataset:
+    """Lazy HDF5-backed dataset with the reference's key layout
+    (reference datasets.py:8-36: train_img/train_labels/val_img/val_labels,
+    swmr single-file). Indexable like ArrayDataset but reads on demand."""
+
+    def __init__(self, path: str, split: str = "train", num_classes: int = 1000):
+        import h5py
+
+        self._f = h5py.File(path, "r", libver="latest", swmr=True)
+        key = "train" if split == "train" else "val"
+        self.data = self._f[f"{key}_img"]
+        self.labels = np.asarray(self._f[f"{key}_labels"], dtype=np.int32)
+        self.num_classes = num_classes
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+
+def load_imagenet_hdf5(
+    data_dir: str, split: str = "train"
+) -> Optional[HDF5ImageDataset]:
+    for name in ("imagenet.hdf5", "imagenet-shuffled.hdf5"):
+        path = os.path.join(data_dir, name)
+        if os.path.exists(path):
+            return HDF5ImageDataset(path, split)
+    return None
+
+
+def create_hdf5(
+    images: np.ndarray, labels: np.ndarray, val_images: np.ndarray,
+    val_labels: np.ndarray, out_path: str,
+) -> None:
+    """Build the single-file HDF5 layout (reference scripts/create_hdf5.py:
+    46-108: NxSxSx3 uint8 + int labels under train_/val_ keys)."""
+    import h5py
+
+    with h5py.File(out_path, "w") as f:
+        f.create_dataset("train_img", data=images, dtype="uint8")
+        f.create_dataset("train_labels", data=labels.astype(np.int64))
+        f.create_dataset("val_img", data=val_images, dtype="uint8")
+        f.create_dataset("val_labels", data=val_labels.astype(np.int64))

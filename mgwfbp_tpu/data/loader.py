@@ -1,0 +1,142 @@
+"""In-memory array dataset + sharded epoch loader.
+
+Replaces the reference's torch `DataLoader` + `DistributedSampler` pairs
+(reference dl_trainer.py:317-539) with a NumPy pipeline: datasets expose
+indexable arrays; the loader owns the epoch permutation (sharded via
+`sharding.shard_indices`), batching, and normalization, and yields host
+numpy batches ready for device put (the trainer lays them out on the mesh).
+
+Double-buffered prefetch happens at the trainer level via
+`jax.device_put` overlap; the loader itself stays synchronous and
+deterministic (same seed -> same batches, rank-disjoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from mgwfbp_tpu.data.sharding import ShardInfo, shard_indices
+
+
+@dataclasses.dataclass
+class ArrayDataset:
+    """data[N, ...], labels[N] (+ optional per-sample aux like lengths)."""
+
+    data: np.ndarray
+    labels: np.ndarray
+    num_classes: int
+
+    def __post_init__(self):
+        if len(self.data) != len(self.labels):
+            raise ValueError("data/labels length mismatch")
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+class ShardedLoader:
+    """Epoch-based sharded batch iterator.
+
+    `set_epoch` reshuffles deterministically (reference
+    train_sampler.set_epoch, dl_trainer.py:778-779). Batches are per-process
+    (weak scaling: the reference's batch_size is per worker,
+    dl_trainer.py:153-156).
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int,
+        shard: ShardInfo = ShardInfo(),
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shard = shard
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.transform = transform
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    @property
+    def num_batches(self) -> int:
+        per_rank = len(
+            shard_indices(
+                len(self.dataset), self.shard, 0, self.shuffle, self.seed,
+                self.drop_last,
+            )
+        )
+        if self.drop_last:
+            return per_rank // self.batch_size
+        return (per_rank + self.batch_size - 1) // self.batch_size
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        idx = shard_indices(
+            len(self.dataset), self.shard, self.epoch, self.shuffle,
+            self.seed, self.drop_last,
+        )
+        if self.drop_last:
+            nb = len(idx) // self.batch_size
+        else:
+            nb = (len(idx) + self.batch_size - 1) // self.batch_size
+        for b in range(nb):
+            sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            x = _gather(self.dataset.data, sel)
+            y = self.dataset.labels[sel]
+            if self.transform is not None:
+                x = self.transform(x)
+            yield x, y
+
+
+def _gather(data, sel: np.ndarray) -> np.ndarray:
+    """Fancy-index `data[sel]` for ndarray OR h5py dataset backends.
+
+    h5py only accepts strictly-increasing duplicate-free index lists, while
+    shuffled/padded shard indices are neither; read the sorted unique set and
+    scatter back (one HDF5 read per batch, still sequential-ish on disk).
+    """
+    if isinstance(data, np.ndarray):
+        return data[sel]
+    usel, inverse = np.unique(sel, return_inverse=True)
+    return np.asarray(data[usel.tolist()])[inverse]
+
+
+def infinite_batches(loader: ShardedLoader, start_epoch: int = 0):
+    """Auto-restarting iterator with epoch bumping (reference `data_iter`,
+    dl_trainer.py:568-576). Yields (epoch, batch)."""
+    epoch = start_epoch
+    while True:
+        loader.set_epoch(epoch)
+        for batch in loader:
+            yield epoch, batch
+        epoch += 1
+
+
+def normalize_images(
+    mean: tuple[float, ...], std: tuple[float, ...]
+) -> Callable[[np.ndarray], np.ndarray]:
+    """uint8 HWC images -> normalized float32 (the reference's torchvision
+    transforms.Normalize equivalents, dl_trainer.py:369-409)."""
+    mean_a = np.asarray(mean, dtype=np.float32)
+    std_a = np.asarray(std, dtype=np.float32)
+
+    def _t(x: np.ndarray) -> np.ndarray:
+        x = x.astype(np.float32) / 255.0
+        return (x - mean_a) / std_a
+
+    return _t

@@ -1,0 +1,68 @@
+"""Distributed data sharding — the `DistributedSampler` of this framework.
+
+The reference shards every dataset with
+`torch.utils.data.distributed.DistributedSampler(num_replicas=nworkers, rank)`
+and reshuffles per epoch via `set_epoch` (reference dl_trainer.py:344-348,
+778-779). Here the same contract is a pure index computation: a deterministic
+epoch-seeded permutation, padded to a multiple of the world size, sliced
+`rank::nranks`. On TPU one *process* feeds all its local devices, so `rank`
+is `jax.process_index()` and the per-process batch is
+`global_batch / process_count` (device-level splitting happens inside the
+mesh via batch-dim sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardInfo:
+    rank: int = 0
+    nranks: int = 1
+
+    def __post_init__(self):
+        if not (0 <= self.rank < self.nranks):
+            raise ValueError(f"rank {self.rank} outside [0, {self.nranks})")
+
+
+def shard_indices(
+    n: int,
+    shard: ShardInfo,
+    epoch: int = 0,
+    shuffle: bool = True,
+    seed: int = 0,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """Indices this rank owns for one epoch.
+
+    Matches DistributedSampler semantics: epoch-seeded global permutation,
+    wrap-around padding so every rank gets the same count, stride slicing.
+    With drop_last, truncates instead of padding (all ranks equal length
+    either way — a collective-deadlock-free guarantee).
+    """
+    if n <= 0:
+        return np.empty((0,), dtype=np.int64)
+    if shuffle:
+        rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2**31 - 1))
+        order = rng.permutation(n)
+    else:
+        order = np.arange(n)
+    if drop_last:
+        total = (n // shard.nranks) * shard.nranks
+        order = order[:total]
+    else:
+        total = ((n + shard.nranks - 1) // shard.nranks) * shard.nranks
+        if total > n:
+            order = np.concatenate([order, order[: total - n]])
+    return order[shard.rank :: shard.nranks]
+
+
+def per_process_batch(global_batch: int, nprocs: int) -> int:
+    if global_batch % nprocs != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {nprocs} processes"
+        )
+    return global_batch // nprocs

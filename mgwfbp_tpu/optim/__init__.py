@@ -1,0 +1,92 @@
+"""Optimizers: SGD with momentum and decay/no-decay parameter groups.
+
+Parity target: reference dl_trainer.py:216-248 — per-dataset momentum /
+weight-decay constants and the bn/bias exclusion (:231-241: params with
+ndim == 1, i.e. batch-norm scales/offsets and biases, get weight_decay=0).
+Expressed as an optax chain so it composes with the MG-WFBP merged
+all-reduce (which runs on raw grads BEFORE this transform — reductions are
+about communication, the optimizer is local math).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mgwfbp_tpu.optim import schedules
+from mgwfbp_tpu.optim.schedules import EpochSchedule, as_step_fn, resolve
+
+ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
+
+def decay_mask(params: Any) -> Any:
+    """True for params that SHOULD get weight decay: ndim > 1 (conv/dense
+    kernels, embeddings). 1-d params (bn scale/offset, biases) are excluded
+    (reference dl_trainer.py:231-241)."""
+    return jax.tree_util.tree_map(lambda p: jnp.ndim(p) > 1, params)
+
+
+def sgd(
+    learning_rate: ScalarOrSchedule,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+) -> optax.GradientTransformation:
+    """SGD + momentum + masked (coupled) weight decay, matching
+    torch.optim.SGD semantics: decay is added to the gradient before the
+    momentum buffer update."""
+    parts = []
+    if weight_decay:
+        parts.append(
+            optax.masked(optax.add_decayed_weights(weight_decay), decay_mask)
+        )
+    if momentum:
+        parts.append(optax.trace(decay=momentum, nesterov=nesterov))
+    parts.append(
+        optax.scale_by_learning_rate(learning_rate)  # handles schedules too
+    )
+    return optax.chain(*parts)
+
+
+def clip_by_global_norm(max_norm: float):
+    """Gradient clipping transform (reference clip_grad_norm_ for the RNN
+    workloads, dist_trainer.py:56-60,89-94: lstm 0.25, lstman4 400)."""
+    return optax.clip_by_global_norm(max_norm)
+
+
+def make_optimizer(
+    base_lr: float,
+    *,
+    momentum: float = 0.9,
+    weight_decay: float = 5e-4,
+    lr_schedule: str = "auto",
+    dataset: str = "cifar10",
+    max_epochs: int = 141,
+    warmup_epochs: int = 5,
+    num_batches_per_epoch: int = 1,
+    norm_clip: Optional[float] = None,
+) -> tuple[optax.GradientTransformation, EpochSchedule]:
+    """Build the full optimizer chain + its epoch schedule (for logging)."""
+    epoch_schedule = resolve(
+        lr_schedule, base_lr, dataset=dataset, max_epochs=max_epochs,
+        warmup_epochs=warmup_epochs,
+    )
+    step_fn = as_step_fn(epoch_schedule, num_batches_per_epoch)
+    tx = sgd(step_fn, momentum=momentum, weight_decay=weight_decay)
+    if norm_clip is not None:
+        tx = optax.chain(clip_by_global_norm(norm_clip), tx)
+    return tx, epoch_schedule
+
+
+__all__ = [
+    "decay_mask",
+    "sgd",
+    "make_optimizer",
+    "clip_by_global_norm",
+    "schedules",
+    "resolve",
+    "as_step_fn",
+]

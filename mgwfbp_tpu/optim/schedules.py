@@ -1,0 +1,154 @@
+"""Learning-rate schedule library.
+
+Parity target: reference dl_trainer.py:578-709 — per-model LR policies keyed
+by epoch: lstman4 anneal (/1.01 per epoch, :578-593), PTB staircase
+(:595-610), general 5-epoch linear warmup + step decays at {81,122,155} for
+CIFAR / {30,60,80} for ImageNet x0.1 (:612-644), vgg halving every 25 epochs
+(:646-651), customized milestone lists (:653-681), cosine with warmup
+(:683-702), and the dispatcher (:704-709).
+
+All schedules are pure `epoch -> lr` callables (float epoch allows
+intra-epoch warmup). `as_step_fn` converts to an optax-style `step -> lr`
+given batches per epoch, so the whole schedule lives inside the jitted train
+step as XLA arithmetic — no host round-trip per iteration.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+EpochSchedule = Callable[[jnp.ndarray], jnp.ndarray]  # float epoch -> lr
+
+CIFAR_MILESTONES = (81, 122, 155)
+IMAGENET_MILESTONES = (30, 60, 80)
+
+
+def constant(lr: float) -> EpochSchedule:
+    return lambda epoch: jnp.asarray(lr, jnp.float32) + 0.0 * epoch
+
+
+def warmup_step(
+    base_lr: float,
+    milestones: Sequence[int] = CIFAR_MILESTONES,
+    gamma: float = 0.1,
+    warmup_epochs: int = 5,
+    warmup_init_scale: float = 0.1,
+) -> EpochSchedule:
+    """Linear warmup then multiplicative decay at milestones (reference
+    dl_trainer.py:612-644)."""
+
+    def fn(epoch):
+        epoch = jnp.asarray(epoch, jnp.float32)
+        warm_frac = jnp.clip(epoch / max(warmup_epochs, 1e-8), 0.0, 1.0)
+        warm = warmup_init_scale + (1.0 - warmup_init_scale) * warm_frac
+        factor = jnp.ones((), jnp.float32)
+        for m in milestones:
+            factor = factor * jnp.where(epoch >= m, gamma, 1.0)
+        if warmup_epochs <= 0:
+            warm = jnp.ones((), jnp.float32)
+        return base_lr * warm * factor
+
+    return fn
+
+
+def step_decay(
+    base_lr: float, milestones: Sequence[int], gamma: float = 0.1
+) -> EpochSchedule:
+    """Customized milestone decay, no warmup (reference :653-681)."""
+    return warmup_step(base_lr, milestones, gamma, warmup_epochs=0)
+
+
+def vgg_halving(base_lr: float, every: int = 25) -> EpochSchedule:
+    """Halve every `every` epochs (reference :646-651)."""
+
+    def fn(epoch):
+        epoch = jnp.asarray(epoch, jnp.float32)
+        return base_lr * jnp.power(0.5, jnp.floor(epoch / every))
+
+    return fn
+
+
+def ptb_staircase(
+    base_lr: float, decay_start: int = 6, decay: float = 1.2
+) -> EpochSchedule:
+    """Hold, then divide by `decay` each epoch past `decay_start` (reference
+    :595-610; classic PTB large-LSTM recipe — base lr 22)."""
+
+    def fn(epoch):
+        epoch = jnp.asarray(epoch, jnp.float32)
+        k = jnp.clip(jnp.floor(epoch) - decay_start + 1, 0.0, None)
+        return base_lr * jnp.power(1.0 / decay, k)
+
+    return fn
+
+
+def anneal(base_lr: float, factor: float = 1.01) -> EpochSchedule:
+    """Divide by `factor` each epoch (reference lstman4 anneal, :578-593)."""
+
+    def fn(epoch):
+        epoch = jnp.asarray(epoch, jnp.float32)
+        return base_lr * jnp.power(1.0 / factor, jnp.floor(epoch))
+
+    return fn
+
+
+def cosine_warmup(
+    base_lr: float, total_epochs: int, warmup_epochs: int = 5,
+    min_lr: float = 0.0,
+) -> EpochSchedule:
+    """Linear warmup into a cosine decay (reference :683-702)."""
+
+    def fn(epoch):
+        epoch = jnp.asarray(epoch, jnp.float32)
+        warm = jnp.clip(epoch / max(warmup_epochs, 1e-8), 0.0, 1.0)
+        t = jnp.clip(
+            (epoch - warmup_epochs) / max(total_epochs - warmup_epochs, 1e-8),
+            0.0,
+            1.0,
+        )
+        cos = min_lr + 0.5 * (base_lr - min_lr) * (1.0 + jnp.cos(math.pi * t))
+        return jnp.where(epoch < warmup_epochs, base_lr * warm, cos)
+
+    return fn
+
+
+def resolve(
+    name: str,
+    base_lr: float,
+    dataset: str = "cifar10",
+    max_epochs: int = 141,
+    warmup_epochs: int = 5,
+) -> EpochSchedule:
+    """Schedule dispatcher (reference :704-709 `adjust_learning_rate`)."""
+    name = (name or "auto").lower()
+    if name == "auto" or name == "step":
+        milestones = (
+            IMAGENET_MILESTONES if dataset == "imagenet" else CIFAR_MILESTONES
+        )
+        return warmup_step(base_lr, milestones, warmup_epochs=warmup_epochs)
+    if name == "cosine":
+        return cosine_warmup(base_lr, max_epochs, warmup_epochs)
+    if name == "ptb":
+        return ptb_staircase(base_lr)
+    if name == "anneal":
+        return anneal(base_lr)
+    if name == "vgg":
+        return vgg_halving(base_lr)
+    if name == "const":
+        return constant(base_lr)
+    raise ValueError(f"unknown lr schedule {name!r}")
+
+
+def as_step_fn(
+    schedule: EpochSchedule, num_batches_per_epoch: int
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """`step -> lr` for use inside the jitted train step."""
+
+    def fn(step):
+        epoch = jnp.asarray(step, jnp.float32) / max(num_batches_per_epoch, 1)
+        return schedule(epoch)
+
+    return fn

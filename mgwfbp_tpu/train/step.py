@@ -1,0 +1,327 @@
+"""The jitted data-parallel train step with MG-WFBP merged collectives.
+
+This is the TPU answer to the reference's hot loop (SURVEY.md §3.1):
+`loss.backward()` firing per-layer hooks that launch Horovod async allreduces
+(reference distributed_optimizer.py:356-367), synchronized before
+`optimizer.step()` (:369-431). Under XLA the entire iteration is ONE program:
+
+  * the backward pass and the per-merge-group `lax.pmean`s coexist in one
+    XLA computation; each group's collective depends only on its members'
+    gradients, so XLA's latency-hiding scheduler overlaps group k's
+    all-reduce with the backward compute of earlier layers — the same
+    overlap the reference builds from hooks+handles, but compiler-scheduled;
+  * the merge schedule (solver) controls collective granularity, trading
+    startup latency alpha against overlap, exactly as in the paper;
+  * gradient accumulation (`nsteps_update`, reference dist_trainer.py:77-88)
+    is a `lax.scan` over micro-batches with communication only after the
+    last micro-step (parity with `optimizer.local=True` skipping hooks);
+  * the optimizer chain (incl. norm clipping AFTER reduction, reference
+    dist_trainer.py:89-94) runs replicated on every device.
+
+Sharding: params/opt_state replicated (P()), batch sharded on the data axis
+(P('data')), all inside one `jax.shard_map` over the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mgwfbp_tpu.models import ModelMeta
+from mgwfbp_tpu.parallel.allreduce import MergedAllreduce
+from mgwfbp_tpu.parallel.mesh import DATA_AXIS
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: jax.Array
+
+    @property
+    def has_batch_stats(self) -> bool:
+        return bool(jax.tree_util.tree_leaves(self.batch_stats))
+
+
+def create_train_state(
+    rng: jax.Array,
+    model: Any,
+    example_input: jax.Array,
+    tx: optax.GradientTransformation,
+    model_kwargs: Optional[dict] = None,
+) -> TrainState:
+    """Initialize params/batch_stats/opt_state (host-side, unsharded)."""
+    init_rng, state_rng = jax.random.split(rng)
+    variables = model.init(
+        {"params": init_rng}, example_input, train=False, **(model_kwargs or {})
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        rng=state_rng,
+    )
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_loss_fn(model: Any, meta: ModelMeta, aux_weight: float = 0.3) -> Callable:
+    """loss_fn(params, batch_stats, batch, rng, carry) ->
+    (loss, (new_batch_stats, new_carry, metrics)).
+
+    Handles the reference's model-specific forward/loss paths
+    (dl_trainer.py:802-818): aux-logits CNNs (googlenet/inceptionv3 0.3 aux
+    weight), LM with carried hidden state, CTC for speech.
+    """
+
+    def loss_fn(params, batch_stats, batch, rng, carry):
+        variables = {"params": params, "batch_stats": batch_stats}
+        rngs = {"dropout": rng}
+        if meta.task == "classify":
+            out, updates = model.apply(
+                variables, batch["x"], train=True,
+                mutable=["batch_stats"], rngs=rngs,
+            )
+            if meta.has_aux_logits:
+                logits, *aux = out
+                loss = cross_entropy(logits, batch["y"])
+                for a in aux:
+                    loss = loss + aux_weight * cross_entropy(a, batch["y"])
+            else:
+                logits = out
+                loss = cross_entropy(logits, batch["y"])
+            correct = (jnp.argmax(logits, -1) == batch["y"]).mean()
+            metrics = {"loss": loss, "accuracy": correct}
+            return loss, (updates.get("batch_stats", batch_stats), carry, metrics)
+        if meta.task == "lm":
+            (logits, new_carry), updates = model.apply(
+                variables, batch["x"], carry=carry, train=True,
+                mutable=["batch_stats"], rngs=rngs,
+            )
+            loss = cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), batch["y"].reshape(-1)
+            )
+            metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
+            return loss, (updates.get("batch_stats", batch_stats), new_carry, metrics)
+        if meta.task == "ctc":
+            (logits, out_lengths), updates = model.apply(
+                variables, batch["x"], batch["input_lengths"], train=True,
+                mutable=["batch_stats"], rngs=rngs,
+            )
+            t = logits.shape[1]
+            logit_pad = (
+                jnp.arange(t)[None, :] >= out_lengths[:, None]
+            ).astype(jnp.float32)
+            label_pad = (
+                jnp.arange(batch["y"].shape[1])[None, :]
+                >= batch["label_lengths"][:, None]
+            ).astype(jnp.float32)
+            per_seq = optax.ctc_loss(logits, logit_pad, batch["y"], label_pad)
+            loss = per_seq.mean()
+            metrics = {"loss": loss}
+            return loss, (updates.get("batch_stats", batch_stats), carry, metrics)
+        raise ValueError(f"unknown task {meta.task!r}")
+
+    return loss_fn
+
+
+def make_train_step(
+    model: Any,
+    meta: ModelMeta,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    reducer: Optional[MergedAllreduce] = None,
+    *,
+    nsteps_update: int = 1,
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted sharded train step.
+
+    reducer: the MG-WFBP merged all-reduce (None -> one flat pmean, i.e. the
+    reference's single-group / SyncEASGD limit is reducer with policy
+    'single'; true WFBP baseline is policy 'wfbp'; None is "let XLA fuse",
+    the ORIGINAL_HOROVOD-style oracle, SURVEY.md §5 config system).
+
+    Returned signature:
+      classify/ctc: step(state, batch) -> (state, metrics)
+      lm:           step(state, batch, carry) -> (state, metrics, carry)
+    Batch leaves are (nsteps_update, global_batch, ...); sharded on dim 1.
+    """
+    loss_fn = make_loss_fn(model, meta)
+    has_carry = meta.has_carry
+
+    def per_device(state: TrainState, batch, carry):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        # decorrelate dropout across data-parallel members
+        step_rng = jax.random.fold_in(step_rng, lax.axis_index(axis_name))
+
+        def micro(acc, xs):
+            micro_batch, micro_idx = xs
+            grads_sum, bstats, mcarry, metrics_sum = acc
+            g_fn = jax.grad(loss_fn, has_aux=True)
+            # distinct dropout mask per micro-step
+            micro_rng = jax.random.fold_in(step_rng, micro_idx)
+            grads, (bstats, mcarry, metrics) = g_fn(
+                state.params, bstats, micro_batch, micro_rng, mcarry
+            )
+            grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
+            metrics_sum = jax.tree_util.tree_map(jnp.add, metrics_sum, metrics)
+            return (grads_sum, bstats, mcarry, metrics_sum), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        zero_metrics = {
+            "loss": jnp.zeros(()),
+            **({"accuracy": jnp.zeros(())} if meta.task == "classify" else {}),
+            **({"perplexity": jnp.zeros(())} if meta.task == "lm" else {}),
+        }
+        (grads, bstats, new_carry, metrics), _ = lax.scan(
+            micro,
+            (zeros, state.batch_stats, carry, zero_metrics),
+            (batch, jnp.arange(nsteps_update)),
+        )
+        inv = 1.0 / float(nsteps_update)
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics)
+        # ---- the communication step: merged groups or one flat pmean ----
+        if reducer is not None:
+            grads = reducer(grads)
+        else:
+            grads = lax.pmean(grads, axis_name)
+        metrics = lax.pmean(metrics, axis_name)
+        # BN running stats: keep replicas identical (the reference leaves
+        # them per-GPU; syncing is strictly better and required for the
+        # replicated out-spec)
+        if jax.tree_util.tree_leaves(bstats):
+            bstats = lax.pmean(bstats, axis_name)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=bstats,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics, new_carry
+
+    batch_spec = P(None, axis_name)  # (nsteps, batch, ...)
+    if has_carry:
+        fn = jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), batch_spec, P(axis_name)),
+            out_specs=(P(), P(), P(axis_name)),
+            check_vma=False,
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 2) if donate else ())
+        def step_lm(state, batch, carry):
+            return fn(state, batch, carry)
+
+        return step_lm
+
+    def per_device_nocarry(state, batch):
+        s, m, _ = per_device(state, batch, None)
+        return s, m
+
+    fn = jax.shard_map(
+        per_device_nocarry,
+        mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state, batch):
+        return fn(state, batch)
+
+    return step
+
+
+def make_eval_step(
+    model: Any,
+    meta: ModelMeta,
+    mesh: Mesh,
+    axis_name: str = DATA_AXIS,
+) -> Callable:
+    """Sharded eval step (reference `test`, dl_trainer.py:854-937).
+
+    classify -> {loss, top1, top5} means; lm -> {loss, perplexity};
+    ctc -> {loss} (WER decoding is host-side, evaluate.py).
+    """
+
+    def per_device(state: TrainState, batch, carry):
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        if meta.task == "classify":
+            logits = model.apply(variables, batch["x"], train=False)
+            if isinstance(logits, (tuple, list)):
+                logits = logits[0]
+            loss = cross_entropy(logits, batch["y"])
+            top1 = (jnp.argmax(logits, -1) == batch["y"]).mean()
+            k = min(5, logits.shape[-1])
+            topk = jax.lax.top_k(logits, k)[1]
+            top5 = (topk == batch["y"][:, None]).any(-1).mean()
+            metrics = {"loss": loss, "top1": top1, "top5": top5}
+            return lax.pmean(metrics, axis_name), carry
+        if meta.task == "lm":
+            logits, new_carry = model.apply(
+                variables, batch["x"], carry=carry, train=False
+            )
+            loss = cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), batch["y"].reshape(-1)
+            )
+            metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
+            return lax.pmean(metrics, axis_name), new_carry
+        if meta.task == "ctc":
+            logits, out_lengths = model.apply(
+                variables, batch["x"], batch["input_lengths"], train=False
+            )
+            t = logits.shape[1]
+            logit_pad = (
+                jnp.arange(t)[None, :] >= out_lengths[:, None]
+            ).astype(jnp.float32)
+            label_pad = (
+                jnp.arange(batch["y"].shape[1])[None, :]
+                >= batch["label_lengths"][:, None]
+            ).astype(jnp.float32)
+            loss = optax.ctc_loss(logits, logit_pad, batch["y"], label_pad).mean()
+            return lax.pmean({"loss": loss}, axis_name), carry
+        raise ValueError(meta.task)
+
+    if meta.has_carry:
+        fn = jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), P(axis_name), P(axis_name)),
+            out_specs=(P(), P(axis_name)),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def per_device_nocarry(state, batch):
+        m, _ = per_device(state, batch, None)
+        return m
+
+    fn = jax.shard_map(
+        per_device_nocarry,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)

@@ -1,0 +1,242 @@
+"""Data subsystem tests: sharding semantics (DistributedSampler parity),
+loader determinism, dataset dispatch, HDF5 round-trip, PTB windowing."""
+
+import numpy as np
+import pytest
+
+from mgwfbp_tpu.data import ShardInfo, ShardedLoader, data_prepare, infinite_batches
+from mgwfbp_tpu.data.datasets import create_hdf5, synthetic_images
+from mgwfbp_tpu.data.loader import ArrayDataset
+from mgwfbp_tpu.data.ptb import synthetic_ptb, windowed_lm_dataset
+from mgwfbp_tpu.data.sharding import per_process_batch, shard_indices
+
+
+def test_shard_indices_partition_and_padding():
+    n, nranks = 103, 4
+    all_idx = [
+        shard_indices(n, ShardInfo(r, nranks), epoch=3, seed=7)
+        for r in range(nranks)
+    ]
+    lens = {len(a) for a in all_idx}
+    assert lens == {26}  # padded to 104 then split evenly
+    flat = np.concatenate(all_idx)
+    # every sample covered at least once (padding duplicates one)
+    assert set(flat.tolist()) == set(range(n))
+
+
+def test_shard_indices_epoch_reshuffle_deterministic():
+    a1 = shard_indices(100, ShardInfo(0, 2), epoch=0, seed=1)
+    a2 = shard_indices(100, ShardInfo(0, 2), epoch=0, seed=1)
+    b = shard_indices(100, ShardInfo(0, 2), epoch=1, seed=1)
+    assert np.array_equal(a1, a2)
+    assert not np.array_equal(a1, b)
+
+
+def test_shard_indices_drop_last_equal_lengths():
+    for r in range(3):
+        idx = shard_indices(100, ShardInfo(r, 3), drop_last=True, shuffle=False)
+        assert len(idx) == 33
+
+
+def test_loader_ranks_disjoint_per_epoch():
+    ds = synthetic_images(64, (8, 8, 3), 10)
+    loaders = [
+        ShardedLoader(ds, 8, ShardInfo(r, 2), seed=5) for r in range(2)
+    ]
+    for l in loaders:
+        l.set_epoch(2)
+    seen = [set(), set()]
+    for r, l in enumerate(loaders):
+        for x, y in l:
+            assert x.shape == (8, 8, 8, 3)
+            for row in y:
+                seen[r].add(int(row))
+    # labels overlap is fine; verify index disjointness via raw indices
+    i0 = shard_indices(64, ShardInfo(0, 2), 2, True, 5)
+    i1 = shard_indices(64, ShardInfo(1, 2), 2, True, 5)
+    assert set(i0).isdisjoint(set(i1))
+
+
+def test_infinite_batches_rolls_epochs():
+    ds = synthetic_images(32, (4, 4, 1), 10)
+    loader = ShardedLoader(ds, 16, seed=0)
+    it = infinite_batches(loader)
+    epochs = [next(it)[0] for _ in range(5)]
+    assert epochs == [0, 0, 1, 1, 2]
+
+
+def test_data_prepare_synthetic_cifar10():
+    bundle = data_prepare("cifar10", batch_size=16, synthetic=True)
+    assert bundle.synthetic and bundle.num_classes == 10
+    x, y = next(iter(bundle.train))
+    assert x.shape == (16, 32, 32, 3) and x.dtype == np.float32
+    assert abs(float(x.mean())) < 2.0  # normalized
+    assert y.dtype == np.int32
+
+
+def test_data_prepare_imagenet_synthetic_resize():
+    bundle = data_prepare("imagenet", batch_size=2, synthetic=True, image_hw=(64, 64))
+    x, y = next(iter(bundle.train))
+    assert x.shape == (2, 64, 64, 3)
+    assert bundle.num_classes == 1000
+
+
+def test_data_prepare_real_when_missing_raises():
+    with pytest.raises(FileNotFoundError):
+        data_prepare("cifar10", data_dir="/nonexistent", synthetic=False)
+
+
+def test_data_prepare_weak_scaling_batch_count():
+    solo = data_prepare("cifar10", batch_size=16, synthetic=True)
+    duo = data_prepare(
+        "cifar10", batch_size=16, shard=ShardInfo(0, 2), synthetic=True
+    )
+    assert solo.num_batches_per_epoch == 2 * duo.num_batches_per_epoch
+
+
+def test_hdf5_roundtrip(tmp_path):
+    from mgwfbp_tpu.data.datasets import HDF5ImageDataset
+
+    imgs = np.random.RandomState(0).randint(0, 255, (10, 8, 8, 3), dtype=np.uint8)
+    labels = np.arange(10)
+    path = str(tmp_path / "im.hdf5")
+    create_hdf5(imgs, labels, imgs[:4], labels[:4], path)
+    ds = HDF5ImageDataset(path, "train")
+    assert len(ds) == 10
+    assert np.array_equal(ds.data[3], imgs[3])
+    val = HDF5ImageDataset(path, "val")
+    assert len(val) == 4
+
+
+def test_ptb_windowing_targets_shifted():
+    stream = np.arange(71, dtype=np.int32)
+    ds = windowed_lm_dataset(stream, num_steps=7, vocab_size=100)
+    assert ds.data.shape == (10, 7)
+    assert np.array_equal(ds.labels[0], ds.data[0] + 1)
+
+
+def test_ptb_synthetic_has_structure():
+    ds = synthetic_ptb(n_windows=16)
+    assert ds.data.shape == (16, 35)
+    assert ds.num_classes == 10000
+    # targets are the 1-shifted stream
+    assert ds.data[0, 1] == ds.labels[0, 0]
+
+
+def test_per_process_batch_validates():
+    assert per_process_batch(128, 4) == 32
+    with pytest.raises(ValueError):
+        per_process_batch(100, 3)
+
+
+def test_hdf5_loader_shuffled_fancy_index(tmp_path):
+    # h5py rejects unsorted/duplicate fancy indices; the loader must handle
+    # shuffled + padded shard indices against an HDF5 backend.
+    from mgwfbp_tpu.data.datasets import HDF5ImageDataset, create_hdf5
+    from mgwfbp_tpu.data.loader import ShardedLoader
+
+    imgs = np.arange(20 * 4 * 4 * 3, dtype=np.uint8).reshape(20, 4, 4, 3)
+    labels = np.arange(20)
+    path = str(tmp_path / "im.hdf5")
+    create_hdf5(imgs, labels, imgs[:4], labels[:4], path)
+    ds = HDF5ImageDataset(path, "train", num_classes=20)
+    loader = ShardedLoader(ds, 7, ShardInfo(0, 3), shuffle=True, seed=3,
+                           drop_last=False)
+    batches = list(loader)
+    assert batches
+    for x, y in batches:
+        # image content must match its label row (content integrity after
+        # the unique/scatter round-trip)
+        for img, lab in zip(x, y):
+            assert np.array_equal(img, imgs[lab])
+
+
+def test_ptb_carry_layout_contiguous():
+    from mgwfbp_tpu.data.ptb import carry_layout
+    from mgwfbp_tpu.data.loader import ShardedLoader
+
+    stream = np.arange(2001, dtype=np.int32)
+    B, T = 4, 10
+    ds = carry_layout(stream, T, B, rank=0, nranks=2, vocab_size=3000)
+    loader = ShardedLoader(ds, B, shuffle=False)
+    batches = list(loader)
+    assert len(batches) >= 2
+    x0, y0 = batches[0]
+    x1, y1 = batches[1]
+    # element j of batch 1 continues exactly where batch 0's element j ended
+    for j in range(B):
+        assert x1[j, 0] == x0[j, -1] + 1
+        # targets are inputs shifted by one
+        assert y0[j, 0] == x0[j, 0] + 1
+    # rank 1 owns different (later) parts of the corpus
+    ds_r1 = carry_layout(stream, T, B, rank=1, nranks=2, vocab_size=3000)
+    assert ds_r1.data[0, 0] > ds.data[0, 0]
+
+
+def test_data_prepare_ptb_stateful_batches():
+    bundle = data_prepare("ptb", batch_size=8, synthetic=True)
+    b0, b1 = list(bundle.train)[:2]
+    assert np.array_equal(b1[0][:, 0], b0[0][:, -1] * 0 + b1[0][:, 0])
+    # continuity: batch1 inputs start at batch0's next token (stream built
+    # from windows -> check via targets alignment)
+    assert np.array_equal(b0[1][:, -1], b1[0][:, 0])
+
+
+def test_synthetic_images_many_classes_have_signal():
+    ds = synthetic_images(256, (8, 8, 3), 1000, seed=0)
+    means = ds.data.reshape(256, -1).mean(1)
+    corr = np.corrcoef(means, ds.labels)[0, 1]
+    assert corr > 0.5  # class signal survives num_classes > 128
+
+
+def test_image_hw_mismatch_on_real_data_raises(tmp_path):
+    from mgwfbp_tpu.data.datasets import create_hdf5
+
+    imgs = np.zeros((8, 16, 16, 3), np.uint8)
+    labels = np.zeros(8)
+    create_hdf5(imgs, labels, imgs, labels, str(tmp_path / "imagenet.hdf5"))
+    with pytest.raises(ValueError, match="image_hw"):
+        data_prepare("imagenet", data_dir=str(tmp_path), image_hw=(32, 32))
+
+
+def test_an4_synthetic_bundle_and_decoder():
+    from mgwfbp_tpu.data.audio import (
+        BLANK_ID,
+        LABELS,
+        greedy_decode,
+        ids_to_text,
+        text_to_ids,
+        wer,
+    )
+
+    bundle = data_prepare("an4", batch_size=4, synthetic=True)
+    assert bundle.num_classes == 29
+    batch = next(iter(bundle.train))
+    assert batch["x"].ndim == 3 and batch["x"].shape[2] == 161
+    assert (batch["input_lengths"] > 0).all()
+    assert (batch["y"][batch["y"] > 0] < 29).all()
+    # greedy decode collapses repeats and drops blanks
+    T, K = 6, 29
+    logits = np.full((1, T, K), -10.0)
+    seq = [BLANK_ID, 3, 3, BLANK_ID, 4, 4]  # -> "BC"
+    for t, s in enumerate(seq):
+        logits[0, t, s] = 10.0
+    out = greedy_decode(logits, np.asarray([T]))
+    assert out == [ids_to_text([3, 4])]
+    assert wer("hello world", "hello world") == 0.0
+    assert wer("hello", "hello world") == 0.5
+    rt = text_to_ids("AB C")
+    assert ids_to_text(rt) == "AB C"
+
+
+def test_audio_bucketing_sorted_and_sharded():
+    from mgwfbp_tpu.data.audio import AudioBatchLoader, synthetic_an4
+
+    utts = synthetic_an4(32, seed=0)
+    l0 = AudioBatchLoader(utts, 4, ShardInfo(0, 2), seed=1)
+    l1 = AudioBatchLoader(utts, 4, ShardInfo(1, 2), seed=1)
+    assert len(l0) == len(l1) == 4
+    # batches are duration-bucketed: within a batch, lengths are close
+    for b in l0:
+        spread = b["input_lengths"].max() - b["input_lengths"].min()
+        assert spread <= 60
