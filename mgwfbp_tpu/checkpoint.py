@@ -1,0 +1,94 @@
+"""Checkpoint / resume via orbax.
+
+Parity target (SURVEY.md §5): reference `save_checkpoint` /
+`load_model_from_file` (dl_trainer.py:946-947, 307-312 — torch.save of
+{'state','epoch','iter'} and counter restore), rank-0 `--pretrain` load +
+parameter re-broadcast (dist_trainer.py:32-39,66). Differences by design:
+  * orbax writes sharded/replicated jax arrays directly — the "broadcast
+    after load" step is a sharding constraint, not a collective we code;
+  * the epoch-boundary save the reference constructs but never executes
+    (dl_trainer.py:769-777 builds the filename, no write) actually saves here.
+
+Checkpoint directory naming encodes the experiment config like the
+reference's log/checkpoint dirs (dl_trainer.py:771-777).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from mgwfbp_tpu.train.step import TrainState
+
+
+@dataclasses.dataclass
+class Snapshot:
+    state: TrainState
+    epoch: int
+    iteration: int
+
+
+def checkpoint_dir(base: str, dnn: str, nworkers: int, batch_size: int, lr: float) -> str:
+    """Config-encoding directory (reference dl_trainer.py:771-777 naming)."""
+    return os.path.join(
+        base, f"{dnn}-n{nworkers}-bs{batch_size}-lr{lr:.4f}"
+    )
+
+
+class Checkpointer:
+    """Epoch-indexed checkpoint manager over one run directory."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, snap: Snapshot, wait: bool = False) -> None:
+        payload = {
+            "state": snap.state,
+            "meta": {"epoch": snap.epoch, "iteration": snap.iteration},
+        }
+        self._mgr.save(snap.epoch, args=ocp.args.StandardSave(payload))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_epoch(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, target_state: TrainState, epoch: Optional[int] = None
+    ) -> Optional[Snapshot]:
+        """Restore into the structure of `target_state` (shapes/dtypes must
+        match the current model/optimizer — the reference has the same
+        contract via load_state_dict)."""
+        step = epoch if epoch is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        template = {
+            "state": target_state,
+            "meta": {"epoch": 0, "iteration": 0},
+        }
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        return Snapshot(
+            state=restored["state"],
+            epoch=int(restored["meta"]["epoch"]),
+            iteration=int(restored["meta"]["iteration"]),
+        )
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

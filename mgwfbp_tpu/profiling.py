@@ -1,0 +1,168 @@
+"""Cost measurement: communication micro-benchmark + layer-wise backward
+timing.
+
+Parity targets (SURVEY.md §2.4): reference profiling.py —
+`CommunicationProfiler` (:150-183, allreduce sweep over 8K..504K-element
+tensors, 5 warmup + N timed each, feeding the sklearn alpha-beta fit at
+distributed_optimizer.py:105-127) and `Profiling`/`benchmark` (:13-147,
+per-parameter autograd hooks timestamping gradient arrival over 5 warmup +
+50 timed full fwd/bwd iterations).
+
+TPU re-design: there are no per-op host hooks under jit (SURVEY.md §7 "hard
+parts"), so
+  * the comm profiler times REAL `lax.pmean` collectives of each size inside
+    a tiny jitted shard_map program (block_until_ready timing), then fits
+    alpha-beta with the closed-form least squares from costmodel;
+  * layer-wise backward durations are estimated by measuring the true total
+    backward time and distributing it over arrival-ordered gradient leaves
+    proportionally to an analytic per-leaf backward-cost weight (parameter
+    volume — the dominant term for conv/dense layers). The merge solver is
+    explicitly tolerant of approximate tb (it only compares arrival gaps
+    against alpha); measured totals anchor the scale, which is what matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mgwfbp_tpu.parallel.costmodel import AlphaBeta, fit_alpha_beta
+from mgwfbp_tpu.parallel.mesh import DATA_AXIS
+
+# Reference sweep: 8K..504K float32 elements in 8K steps (profiling.py:158-160)
+# extended upward: TPU interconnects only hit peak bandwidth at MBs.
+DEFAULT_SIZES = tuple(int(2**k) for k in range(13, 25))  # 8K .. 16M elements
+
+
+@dataclasses.dataclass
+class CommProfile:
+    sizes_bytes: list[float]
+    times_s: list[float]
+    model: AlphaBeta
+
+
+def profile_allreduce(
+    mesh: Mesh,
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    warmup: int = 5,
+    iters: int = 20,
+    axis_name: str = DATA_AXIS,
+    dtype=jnp.float32,
+) -> CommProfile:
+    """Time one pmean per payload size on the real mesh; fit t = a + b*bytes.
+
+    Reference protocol: CommunicationProfiler.benchmark (profiling.py:163-182)
+    with synchronize-per-iteration; here each timed call is a jitted psum
+    program completed with block_until_ready.
+    """
+    times, nbytes = [], []
+    itemsize = jnp.dtype(dtype).itemsize
+    for n in sizes:
+
+        def f(x):
+            return lax.pmean(x, axis_name)
+
+        fn = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+            )
+        )
+        x = jnp.ones((n,), dtype)
+        for _ in range(warmup):
+            fn(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn(x).block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        times.append(dt)
+        nbytes.append(n * itemsize)
+    return CommProfile(
+        sizes_bytes=nbytes, times_s=times, model=fit_alpha_beta(nbytes, times)
+    )
+
+
+def backward_cost_weights(params: Any, perm: Sequence[int]) -> np.ndarray:
+    """Analytic per-leaf backward-cost weights in arrival order.
+
+    Parameter volume is the per-layer cost proxy: for dense layers backward
+    FLOPs ~ 2*numel*batch; for convs ~ 2*numel*output_positions*batch — the
+    spatial factor varies, but relative ordering within a model is dominated
+    by numel (the reference's measured tb correlates with layer size for the
+    same reason its threshold policy packs by element count).
+    """
+    leaves = jax.tree_util.tree_leaves(params)
+    w = np.asarray(
+        [float(np.prod(leaves[j].shape)) if leaves[j].shape else 1.0 for j in perm]
+    )
+    return w / max(w.sum(), 1e-12)
+
+
+def measure_step_time(
+    fn: Callable, *args, warmup: int = 5, iters: int = 50
+) -> float:
+    """5 warmup + 50 timed iterations (reference benchmark protocol,
+    profiling.py:100-101). fn must return a pytree of device arrays."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def benchmark_backward(
+    loss_fn: Callable,
+    params: Any,
+    loss_args: tuple,
+    perm: Sequence[int],
+    warmup: int = 5,
+    iters: int = 50,
+) -> list[float]:
+    """Layer-wise backward durations tb (arrival order): measured total
+    backward wall-clock distributed by analytic weights.
+
+    loss_fn(params, *loss_args) -> scalar. The returned list feeds
+    `solver.build_schedule` exactly like the reference's measured
+    `layerwise_times` (dist_trainer.py:45-51).
+    """
+    grad_fn = jax.jit(jax.grad(lambda p: loss_fn(p, *loss_args)))
+    total = measure_step_time(grad_fn, params, warmup=warmup, iters=iters)
+    weights = backward_cost_weights(params, perm)
+    return [float(total * w) for w in weights]
+
+
+def benchmark_trainer_backward(
+    model: Any,
+    meta: Any,
+    params: Any,
+    batch_stats: Any,
+    example_batch: dict,
+    perm: Sequence[int],
+    warmup: int = 5,
+    iters: int = 50,
+) -> list[float]:
+    """benchmark(trainer) parity (reference profiling.py:95-147): time the
+    model's full backward on one device and return arrival-ordered tb."""
+    from mgwfbp_tpu.train.step import make_loss_fn
+
+    loss_fn = make_loss_fn(model, meta)
+    rng = jax.random.PRNGKey(0)
+    carry = None
+    if getattr(meta, "has_carry", False):
+        carry = model.initial_carry(example_batch["x"].shape[0])
+
+    def scalar_loss(p, batch):
+        loss, _ = loss_fn(p, batch_stats, batch, rng, carry)
+        return loss
+
+    return benchmark_backward(
+        scalar_loss, params, (example_batch,), perm, warmup=warmup, iters=iters
+    )

@@ -1,0 +1,112 @@
+"""Training CLI — the launcher surface of the framework.
+
+Parity targets (SURVEY.md §2.3, L7/L6): reference dist_trainer.py __main__
+(:105-143 argparse: batch-size, nworkers, dnn, dataset, nsteps-update,
+compressor/density/threshold) and the exp_configs/*.conf presets sourced by
+dist_mpi.sh / single.sh. One CLI serves both the single-host and multi-host
+paths (`--coordinator`/`--num-processes`/`--process-id` replace mpirun +
+hostfiles; on a TPU pod slice these come from the runtime environment).
+
+Examples:
+  python -m mgwfbp_tpu.train_cli --dnn resnet20 --max-epochs 2 --synthetic
+  python -m mgwfbp_tpu.train_cli --dnn resnet50 --dataset imagenet \
+      --policy mgwfbp --connection ici
+  python -m mgwfbp_tpu.train_cli --dnn resnet20 --policy threshold \
+      --threshold 524288000   # single-group baseline (batch_dist_mpi.sh grid)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+from mgwfbp_tpu.config import PRESETS, TrainConfig, make_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mgwfbp-train",
+        description="TPU-native MG-WFBP distributed training",
+    )
+    p.add_argument("--dnn", default="resnet20", help=f"model: {sorted(PRESETS)}")
+    p.add_argument("--dataset", default=None)
+    p.add_argument("--data-dir", dest="data_dir", default=None)
+    p.add_argument("--batch-size", dest="batch_size", type=int, default=None,
+                   help="PER-DEVICE batch (weak scaling, reference semantics)")
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--max-epochs", dest="max_epochs", type=int, default=None)
+    p.add_argument("--nsteps-update", dest="nsteps_update", type=int,
+                   default=None, help="gradient accumulation micro-steps")
+    p.add_argument("--policy", default=None,
+                   choices=["mgwfbp", "threshold", "single", "wfbp", "none"],
+                   help="merge policy; 'none' = XLA-fused oracle")
+    p.add_argument("--threshold", type=int, default=None,
+                   help="elements per group for --policy threshold")
+    p.add_argument("--connection", default=None,
+                   help="cost-model link class: ici|dcn|56GbIB|10GbE")
+    p.add_argument("--comm-profile", dest="comm_profile", default=None,
+                   help="path to calibrated alpha-beta json (see calibrate)")
+    p.add_argument("--comm-dtype", dest="comm_dtype", default=None,
+                   help="wire dtype for collectives, e.g. bfloat16")
+    p.add_argument("--norm-clip", dest="norm_clip", type=float, default=None)
+    p.add_argument("--lr-schedule", dest="lr_schedule", default=None)
+    p.add_argument("--logdir", default=None)
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--seq-parallel", dest="seq_parallel", type=int, default=None)
+    p.add_argument("--synthetic", action="store_true",
+                   help="force synthetic data (no dataset files needed)")
+    p.add_argument("--no-profile-backward", action="store_true",
+                   help="skip the offline backward benchmark (size prior)")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="run this many epochs from the resume point "
+                        "(default: through --max-epochs, absolute)")
+    p.add_argument("--coordinator", default=None,
+                   help="multi-host coordinator address host:port")
+    p.add_argument("--num-processes", dest="num_processes", type=int, default=None)
+    p.add_argument("--process-id", dest="process_id", type=int, default=None)
+    p.add_argument("--print-config", action="store_true")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    overrides = {
+        k: getattr(args, k)
+        for k in (
+            "dataset", "data_dir", "batch_size", "lr", "max_epochs",
+            "nsteps_update", "policy", "threshold", "connection",
+            "comm_profile", "comm_dtype", "norm_clip", "lr_schedule",
+            "logdir", "checkpoint_dir", "seed", "seq_parallel",
+        )
+        if getattr(args, k, None) is not None
+    }
+    return make_config(args.dnn, **overrides)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    if args.print_config:
+        print(json.dumps(cfg.__dict__, indent=2, default=str))
+        return 0
+    from mgwfbp_tpu.parallel.mesh import init_distributed
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    init_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    trainer = Trainer(
+        cfg,
+        profile_backward=not args.no_profile_backward,
+        synthetic_data=True if args.synthetic else None,
+    )
+    metrics = trainer.fit(args.epochs)
+    print(json.dumps(metrics))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

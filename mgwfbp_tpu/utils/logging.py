@@ -22,21 +22,30 @@ def get_logger(
     logfile: Optional[str] = None,
     level: int = logging.INFO,
 ) -> logging.Logger:
+    """Stream + optional file logger. Safe to call repeatedly: a second call
+    with a DIFFERENT logfile (e.g. two Trainer runs in one process) swaps the
+    file handler to the new path instead of silently logging to the old one.
+    """
     logger = logging.getLogger(name)
-    if getattr(logger, "_mgwfbp_configured", False):
-        return logger
-    logger.setLevel(level)
     fmt = logging.Formatter(_FMT.format(host=socket.gethostname()))
-    sh = logging.StreamHandler()
-    sh.setFormatter(fmt)
-    logger.addHandler(sh)
-    if logfile:
-        os.makedirs(os.path.dirname(logfile) or ".", exist_ok=True)
-        fh = logging.FileHandler(logfile)
-        fh.setFormatter(fmt)
-        logger.addHandler(fh)
-    logger.propagate = False
-    logger._mgwfbp_configured = True  # type: ignore[attr-defined]
+    if not getattr(logger, "_mgwfbp_configured", False):
+        logger.setLevel(level)
+        sh = logging.StreamHandler()
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+        logger.propagate = False
+        logger._mgwfbp_configured = True  # type: ignore[attr-defined]
+    current = getattr(logger, "_mgwfbp_logfile", None)
+    if logfile != current:
+        for h in [h for h in logger.handlers if isinstance(h, logging.FileHandler)]:
+            logger.removeHandler(h)
+            h.close()
+        if logfile:
+            os.makedirs(os.path.dirname(logfile) or ".", exist_ok=True)
+            fh = logging.FileHandler(logfile)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+        logger._mgwfbp_logfile = logfile  # type: ignore[attr-defined]
     return logger
 
 

@@ -19,8 +19,16 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The axon sitecustomize registers the TPU-tunnel backend programmatically, so
 # the env var alone does not win; force CPU through the config API too.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data=8, seq=1))
