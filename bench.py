@@ -1,0 +1,81 @@
+"""Benchmark entry point — run by the driver on real TPU hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the flagship workload (BASELINE.json headline config): ResNet-50 /
+ImageNet-shaped synthetic data, full jitted train step (fwd+bwd+optimizer,
+the same program `mgwfbp_tpu.train` runs in production) on the available
+chip(s). vs_baseline is measured images/s divided by 250 img/s — a
+P100-class single-GPU ResNet-50 fp32 throughput, i.e. one worker of the
+paper's 4xP100 NCCL cluster (the reference repo publishes no numbers,
+BASELINE.md; 250 img/s is the standard figure for that hardware class).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+P100_RESNET50_IMG_S = 250.0
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.optim import make_optimizer
+    from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mgwfbp_tpu.train import create_train_state, make_train_step
+
+    batch = int(os.environ.get("MGWFBP_BENCH_BATCH", "32"))
+    devices = jax.devices()
+    mesh = make_mesh(MeshSpec(data=len(devices)))
+    model, meta = zoo.create_model("resnet50")
+    tx, _ = make_optimizer(
+        0.01, momentum=0.9, weight_decay=1e-4, lr_schedule="const",
+        dataset="imagenet", num_batches_per_epoch=1,
+    )
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, jnp.zeros((1, 224, 224, 3)), tx
+    )
+    step = make_train_step(model, meta, tx, mesh, None, donate=False)
+    rs = np.random.RandomState(0)
+    global_batch = batch * len(devices)
+    x = jnp.asarray(rs.randn(1, global_batch, 224, 224, 3), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 1000, (1, global_batch)), jnp.int32)
+    batch_dict = {"x": x, "y": y}
+
+    # compile + warmup
+    state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics)
+    for _ in range(3):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics)
+
+    iters = int(os.environ.get("MGWFBP_BENCH_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics)
+    dt = (time.perf_counter() - t0) / iters
+    img_s = global_batch / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_synthetic_imagenet_train_throughput",
+                "value": round(img_s, 2),
+                "unit": "images/s",
+                "vs_baseline": round(img_s / P100_RESNET50_IMG_S, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,13 +33,6 @@ class Snapshot:
     iteration: int
 
 
-def checkpoint_dir(base: str, dnn: str, nworkers: int, batch_size: int, lr: float) -> str:
-    """Config-encoding directory (reference dl_trainer.py:771-777 naming)."""
-    return os.path.join(
-        base, f"{dnn}-n{nworkers}-bs{batch_size}-lr{lr:.4f}"
-    )
-
-
 class Checkpointer:
     """Epoch-indexed checkpoint manager over one run directory."""
 

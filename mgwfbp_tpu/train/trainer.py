@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mgwfbp_tpu import models as zoo
-from mgwfbp_tpu.checkpoint import Checkpointer, Snapshot, checkpoint_dir
+from mgwfbp_tpu.checkpoint import Checkpointer, Snapshot
 from mgwfbp_tpu.config import TrainConfig
 from mgwfbp_tpu.data import ShardInfo, data_prepare
 from mgwfbp_tpu.optim import make_optimizer
@@ -128,11 +128,10 @@ class Trainer:
         self.eval_step = make_eval_step(self.model, self.meta, self.mesh)
         self.checkpointer = None
         if config.checkpoint_dir:
+            # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
+            # distinct experiments never share a resume directory
             self.checkpointer = Checkpointer(
-                checkpoint_dir(
-                    config.checkpoint_dir, config.dnn,
-                    self.data_size, config.batch_size, config.lr,
-                )
+                os.path.join(config.checkpoint_dir, config.tag())
             )
         self.start_epoch = 0
         self.iteration = 0
@@ -224,10 +223,29 @@ class Trainer:
         return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
 
     def _stack_micro(self, batches: list[dict]) -> dict:
-        """Stack nsteps_update micro-batches on a leading scan axis."""
-        return {
-            k: jnp.stack([b[k] for b in batches]) for k in batches[0]
-        }
+        """Stack nsteps_update micro-batches on a leading scan axis, then
+        (multi-host) assemble the per-process shards into global arrays."""
+        stacked = {k: jnp.stack([b[k] for b in batches]) for k in batches[0]}
+        return self._globalize(stacked, axes=1)
+
+    def _globalize(self, tree, axes: int):
+        """Multi-host: per-process loader slices are the LOCAL shards of one
+        global batch; assemble them into jax global arrays sharded on the
+        data axis (dim `axes`). Single-process: identity — the jitted
+        shard_map splits the local array itself."""
+        if jax.process_count() == 1:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def put(a):
+            spec = [None] * a.ndim
+            spec[axes] = DATA_AXIS
+            sharding = NamedSharding(self.mesh, PartitionSpec(*spec))
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(a)
+            )
+
+        return jax.tree_util.tree_map(put, tree)
 
     # ------------------------------------------------------------------
     def train_epoch(self, epoch: int) -> dict:
@@ -242,7 +260,9 @@ class Trainer:
         metrics: dict = {}
         if self.meta.has_carry:
             # fresh hidden state each epoch (reference init_hidden per epoch)
-            self.carry = self.model.initial_carry(self.process_batch)
+            self.carry = self._globalize(
+                self.model.initial_carry(self.process_batch), axes=0
+            )
         for raw in loader:
             micro.append(self._to_model_batch(raw))
             if len(micro) < nsteps:
@@ -285,17 +305,19 @@ class Trainer:
         sums: dict[str, float] = {}
         count = 0
         carry = (
-            self.model.initial_carry(self.process_batch)
+            self._globalize(
+                self.model.initial_carry(self.process_batch), axes=0
+            )
             if self.meta.has_carry
             else None
         )
         for raw in loader:
-            batch = self._to_model_batch(raw)
+            batch = self._globalize(self._to_model_batch(raw), axes=0)
             b = next(iter(batch.values())).shape[0]
             if b % self.data_size != 0:
                 continue  # remainder batch not shardable; skip (small tail)
             if self.meta.has_carry:
-                if b != self.process_batch:
+                if b != self.process_batch * jax.process_count():
                     continue
                 metrics, carry = self.eval_step(self.state, batch, carry)
             else:
@@ -338,10 +360,25 @@ class Trainer:
                 Snapshot(state=self.state, epoch=epoch, iteration=self.iteration)
             )
 
+    def close(self) -> None:
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+
     def _maybe_resume(self) -> None:
-        if self.checkpointer is None:
-            return
-        snap = self.checkpointer.restore(self.state)
+        snap = None
+        if self.checkpointer is not None:
+            snap = self.checkpointer.restore(self.state)
+        if snap is None and self.config.pretrain:
+            # --pretrain: load weights+counters from another run's checkpoint
+            # directory (reference dist_trainer.py:32-39 rank-0 load)
+            pre = Checkpointer(self.config.pretrain)
+            snap = pre.restore(self.state)
+            pre.close()
+            if snap is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under pretrain dir "
+                    f"{self.config.pretrain!r}"
+                )
         if snap is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 

@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-schedule", dest="lr_schedule", default=None)
     p.add_argument("--logdir", default=None)
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
+    p.add_argument("--pretrain", default=None,
+                   help="checkpoint directory to initialize weights from")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--seq-parallel", dest="seq_parallel", type=int, default=None)
     p.add_argument("--synthetic", action="store_true",
@@ -77,7 +79,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "dataset", "data_dir", "batch_size", "lr", "max_epochs",
             "nsteps_update", "policy", "threshold", "connection",
             "comm_profile", "comm_dtype", "norm_clip", "lr_schedule",
-            "logdir", "checkpoint_dir", "seed", "seq_parallel",
+            "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
         )
         if getattr(args, k, None) is not None
     }
@@ -103,7 +105,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         profile_backward=not args.no_profile_backward,
         synthetic_data=True if args.synthetic else None,
     )
-    metrics = trainer.fit(args.epochs)
+    try:
+        metrics = trainer.fit(args.epochs)
+    finally:
+        trainer.close()
     print(json.dumps(metrics))
     return 0
 

@@ -54,9 +54,11 @@ def run_tag(cfg: Mapping[str, object]) -> str:
     'resnet20-cifar10-n8-bs32-lr0.1-mgwfbp' (reference PREFIX +
     dist_trainer.py:127-128 dir naming)."""
     parts = []
-    for k in ("dnn", "dataset", "nworkers", "batch_size", "lr", "policy", "threshold"):
+    for k in ("dnn", "dataset", "nworkers", "batch_size", "lr", "policy",
+              "threshold", "seed"):
         if k in cfg and cfg[k] is not None:
             v = cfg[k]
-            prefix = {"nworkers": "n", "batch_size": "bs", "lr": "lr", "threshold": "th"}.get(k, "")
+            prefix = {"nworkers": "n", "batch_size": "bs", "lr": "lr",
+                      "threshold": "th", "seed": "s"}.get(k, "")
             parts.append(f"{prefix}{v}")
     return "-".join(str(p) for p in parts) if parts else "run"

@@ -196,3 +196,37 @@ def test_logger_swaps_file_handler(tmp_path):
     assert "one" in open(f1).read()
     content2 = open(f2).read()
     assert "two" in content2 and "one" not in content2
+
+
+def test_pretrain_initializes_from_other_run(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path / "runA"))
+    t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    t.fit(1)
+    t.checkpointer.wait()
+    run_a_dir = t.checkpointer._dir
+    t.close()
+
+    cfg_b = _cfg(pretrain=run_a_dir, seed=4)
+    t2 = Trainer(cfg_b, synthetic_data=True, profile_backward=False)
+    # weights and counters came from run A
+    assert t2.start_epoch == 1
+    a = jax.tree_util.tree_leaves(t.state.params)[0]
+    b = jax.tree_util.tree_leaves(t2.state.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    t2.close()
+
+
+def test_pretrain_missing_raises(tmp_path):
+    cfg = _cfg(pretrain=str(tmp_path / "nope"))
+    with pytest.raises(FileNotFoundError):
+        Trainer(cfg, synthetic_data=True, profile_backward=False)
+
+
+def test_checkpoint_dirs_distinct_per_policy(tmp_path):
+    cfg1 = _cfg(checkpoint_dir=str(tmp_path), policy="mgwfbp")
+    cfg2 = _cfg(checkpoint_dir=str(tmp_path), policy="none")
+    t1 = Trainer(cfg1, synthetic_data=True, profile_backward=False)
+    t2 = Trainer(cfg2, synthetic_data=True, profile_backward=False)
+    assert t1.checkpointer._dir != t2.checkpointer._dir
+    t1.close()
+    t2.close()
