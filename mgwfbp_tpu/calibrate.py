@@ -1,0 +1,56 @@
+"""Communication calibration CLI: measure alpha-beta on the live topology.
+
+Parity target: the reference's CommunicationProfiler + LinearRegression fit
+(reference profiling.py:150-183, distributed_optimizer.py:105-127) — present
+there but dead in the default path, which falls back to hardcoded cluster
+tables. Here calibration is a first-class step: run once per topology,
+persist the profile, and point training at it with --comm-profile.
+
+Usage:
+  python -m mgwfbp_tpu.calibrate --out profiles/v5e8.json
+  python -m mgwfbp_tpu.train_cli --dnn resnet50 --comm-profile profiles/v5e8.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="mgwfbp-calibrate")
+    p.add_argument("--out", required=True, help="output profile json path")
+    p.add_argument("--min-log2", type=int, default=13,
+                   help="smallest payload (log2 elements)")
+    p.add_argument("--max-log2", type=int, default=24,
+                   help="largest payload (log2 elements)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=5)
+    args = p.parse_args(argv)
+
+    from mgwfbp_tpu.parallel.costmodel import save_profile
+    from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mgwfbp_tpu.profiling import profile_allreduce
+
+    mesh = make_mesh(MeshSpec())
+    sizes = tuple(2**k for k in range(args.min_log2, args.max_log2 + 1))
+    prof = profile_allreduce(
+        mesh, sizes=sizes, warmup=args.warmup, iters=args.iters
+    )
+    save_profile(args.out, prof.model)
+    print(
+        json.dumps(
+            {
+                "alpha_s": prof.model.alpha,
+                "beta_s_per_byte": prof.model.beta,
+                "samples": len(prof.sizes_bytes),
+                "out": args.out,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

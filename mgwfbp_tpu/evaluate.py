@@ -1,0 +1,88 @@
+"""Offline evaluation over saved checkpoints.
+
+Parity target (SURVEY.md §3.4): reference evaluate.py (:20-57 — rebuild the
+trainer from hyperparameters encoded in the checkpoint dir name, load each
+epoch's checkpoint, run test(): top1/top5 for CNNs, perplexity for PTB, WER
+for AN4) and scripts/eval.sh. Here the checkpoint directory is the
+config-tagged dir the Trainer writes; model/dataset come from CLI flags
+(explicit beats dir-name parsing).
+
+Usage:
+  python -m mgwfbp_tpu.evaluate --dnn resnet20 --checkpoint-dir ckpts/... \
+      [--epoch N] [--synthetic]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+from mgwfbp_tpu.config import make_config
+
+
+def evaluate(
+    dnn: str,
+    checkpoint_root: str,
+    epoch: Optional[int] = None,
+    synthetic: Optional[bool] = None,
+    **config_overrides,
+) -> dict:
+    """Evaluate one checkpoint (latest by default); returns metrics dict."""
+    from mgwfbp_tpu.checkpoint import Checkpointer
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    cfg = make_config(dnn, checkpoint_dir=None, **config_overrides)
+    trainer = Trainer(cfg, profile_backward=False, synthetic_data=synthetic)
+    ckpt = Checkpointer(checkpoint_root)
+    try:
+        snap = ckpt.restore(trainer.state, epoch=epoch)
+        if snap is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {checkpoint_root!r}"
+                + (f" at epoch {epoch}" if epoch is not None else "")
+            )
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        trainer.state = jax.device_put(
+            snap.state, NamedSharding(trainer.mesh, PartitionSpec())
+        )
+        metrics = trainer.evaluate()
+        metrics["epoch"] = snap.epoch
+        return metrics
+    finally:
+        ckpt.close()
+        trainer.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="mgwfbp-evaluate")
+    p.add_argument("--dnn", required=True)
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", required=True,
+                   help="the run's tagged checkpoint directory")
+    p.add_argument("--epoch", type=int, default=None,
+                   help="epoch to evaluate (default: latest)")
+    p.add_argument("--dataset", default=None)
+    p.add_argument("--data-dir", dest="data_dir", default=None)
+    p.add_argument("--batch-size", dest="batch_size", type=int, default=None)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    overrides = {
+        k: getattr(args, k)
+        for k in ("dataset", "data_dir", "batch_size")
+        if getattr(args, k) is not None
+    }
+    metrics = evaluate(
+        args.dnn,
+        args.checkpoint_dir,
+        epoch=args.epoch,
+        synthetic=True if args.synthetic else None,
+        **overrides,
+    )
+    print(json.dumps(metrics))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -64,6 +64,9 @@ class Trainer:
             MeshSpec(data=-1, seq=config.seq_parallel)
         )
         self.data_size = self.mesh.shape[DATA_AXIS]
+        # reflect the actual worker count into the config so run tags /
+        # checkpoint dirs distinguish 1-device from N-device runs
+        config.nworkers = self.data_size
         self.shard = ShardInfo(jax.process_index(), jax.process_count())
         # weak scaling: per-device batch (reference per-worker batch) times
         # the local extent of the data axis = this process's loader batch
@@ -364,27 +367,36 @@ class Trainer:
         if self.checkpointer is not None:
             self.checkpointer.close()
 
+    def load_checkpoint(self, directory: str, epoch: Optional[int] = None):
+        """Restore a snapshot from a checkpoint dir onto this trainer's mesh
+        (orbax restores committed to one device; re-replicating over the mesh
+        is the reference's post-load broadcast_parameters,
+        dist_trainer.py:66, expressed as a sharding constraint). Returns the
+        Snapshot; raises if none exists."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ckpt = Checkpointer(directory)
+        try:
+            snap = ckpt.restore(self.state, epoch=epoch)
+        finally:
+            ckpt.close()
+        if snap is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {directory!r}"
+                + (f" at epoch {epoch}" if epoch is not None else "")
+            )
+        snap.state = jax.device_put(
+            snap.state, NamedSharding(self.mesh, PartitionSpec())
+        )
+        return snap
+
     def _maybe_resume(self) -> None:
         snap = None
         if self.checkpointer is not None:
             snap = self.checkpointer.restore(self.state)
-        if snap is None and self.config.pretrain:
-            # --pretrain: load weights+counters from another run's checkpoint
-            # directory (reference dist_trainer.py:32-39 rank-0 load)
-            pre = Checkpointer(self.config.pretrain)
-            snap = pre.restore(self.state)
-            pre.close()
-            if snap is None:
-                raise FileNotFoundError(
-                    f"no checkpoint found under pretrain dir "
-                    f"{self.config.pretrain!r}"
-                )
         if snap is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            # orbax restores committed to one device; re-replicate over the
-            # mesh (the reference's post-load broadcast_parameters,
-            # dist_trainer.py:66, expressed as a sharding constraint)
             self.state = jax.device_put(
                 snap.state, NamedSharding(self.mesh, PartitionSpec())
             )
@@ -392,6 +404,21 @@ class Trainer:
             self.iteration = snap.iteration
             self.log.info(
                 "resumed from epoch %d (iter %d)", snap.epoch, snap.iteration
+            )
+            return
+        if self.config.pretrain:
+            # --pretrain initializes WEIGHTS from another run (reference
+            # dist_trainer.py:32-39); counters and optimizer state start
+            # fresh so fine-tuning actually trains (a full resume of the
+            # same run goes through checkpoint_dir instead)
+            pre = self.load_checkpoint(self.config.pretrain)
+            self.state = self.state.replace(
+                params=pre.state.params,
+                batch_stats=pre.state.batch_stats,
+            )
+            self.log.info(
+                "initialized weights from pretrain dir %s (epoch %d)",
+                self.config.pretrain, pre.epoch,
             )
 
     def fit(self, num_epochs: Optional[int] = None) -> dict:

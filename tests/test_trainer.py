@@ -230,3 +230,35 @@ def test_checkpoint_dirs_distinct_per_policy(tmp_path):
     assert t1.checkpointer._dir != t2.checkpointer._dir
     t1.close()
     t2.close()
+
+
+def test_evaluate_cli_offline(tmp_path, capsys):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    t.fit(1)
+    t.checkpointer.wait()
+    run_dir = t.checkpointer._dir
+    t.close()
+
+    from mgwfbp_tpu.evaluate import main as eval_main
+
+    rc = eval_main([
+        "--dnn", "mnistnet", "--checkpoint-dir", run_dir,
+        "--batch-size", "8", "--synthetic",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["epoch"] == 0 and "top1" in out
+
+
+def test_calibrate_cli(tmp_path, capsys):
+    from mgwfbp_tpu.calibrate import main as cal_main
+
+    out_path = str(tmp_path / "prof.json")
+    rc = cal_main(["--out", out_path, "--min-log2", "10", "--max-log2", "13",
+                   "--iters", "2", "--warmup", "1"])
+    assert rc == 0
+    from mgwfbp_tpu.parallel.costmodel import load_profile
+
+    model = load_profile(out_path)
+    assert model.alpha >= 0 and model.beta >= 0
