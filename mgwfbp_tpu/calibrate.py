@@ -29,6 +29,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--warmup", type=int, default=5)
     args = p.parse_args(argv)
 
+    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+
+    apply_platform_overrides()
     from mgwfbp_tpu.parallel.costmodel import save_profile
     from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
     from mgwfbp_tpu.profiling import profile_allreduce

@@ -92,6 +92,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.print_config:
         print(json.dumps(cfg.__dict__, indent=2, default=str))
         return 0
+    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+
+    apply_platform_overrides()
     from mgwfbp_tpu.parallel.mesh import init_distributed
     from mgwfbp_tpu.train.trainer import Trainer
 
