@@ -24,6 +24,7 @@ class TrainConfig:
     lr: float = 0.1
     max_epochs: int = 141
     nsteps_update: int = 1  # gradient accumulation micro-steps (dist_trainer.py:77-88)
+    augment: bool = True  # train-split augmentation (dl_trainer.py:331-336,381-385)
 
     # distributed
     nworkers: int = 1
@@ -34,6 +35,10 @@ class TrainConfig:
     threshold: int = 0  # elements, for policy='threshold' (batch_dist_mpi.sh grid)
     connection: str = "ici"  # cost-model link class (settings.py CONNECTION)
     comm_profile: Optional[str] = None  # path to calibrated alpha-beta json
+
+    # gradient compression seam (reference compression.py, --compressor/--density)
+    compressor: str = "none"  # none | topk
+    density: float = 1.0  # kept fraction for sparsifying compressors
 
     # numerics
     dtype: str = "float32"  # param/compute dtype

@@ -59,12 +59,14 @@ def data_prepare(
     seed: int = 0,
     image_hw: Optional[tuple[int, int]] = None,
     synthetic: Optional[bool] = None,
+    augment: bool = True,
 ) -> DataBundle:
     """Build sharded train/val loaders for a dataset name.
 
     batch_size is PER PROCESS (weak scaling, reference dl_trainer.py:153-156).
     `synthetic=True` forces the synthetic twin; None auto-detects files.
     `image_hw` overrides the image size (inceptions need 299x299).
+    `augment=False` disables training-time augmentation (benchmarking).
     """
     name = dataset.lower()
     if name in ("mnist", "cifar10", "imagenet"):
@@ -102,13 +104,24 @@ def data_prepare(
                     f"under {data_dir!r} store {real_hw} images; rebuild the "
                     "dataset at the requested size (scripts/create_hdf5)"
                 )
-        transform = normalize_images(mean, std)
+        normalize = normalize_images(mean, std)
+        # train-split-only augmentation (reference dl_trainer.py:331-336,
+        # 381-385: RandomCrop+flip for CIFAR, RandomResizedCrop+flip for
+        # ImageNet; eval uses only normalize)
+        train_tf = normalize
+        if augment:
+            from mgwfbp_tpu.data.augment import chain, train_augment
+
+            aug = train_augment(name)
+            if aug is not None:
+                train_tf = chain(aug, normalize)
         train_loader = ShardedLoader(
-            train, batch_size, shard, shuffle=True, seed=seed, transform=transform
+            train, batch_size, shard, shuffle=True, seed=seed,
+            transform=train_tf,
         )
         val_loader = ShardedLoader(
             val, batch_size, shard, shuffle=False, seed=seed,
-            drop_last=False, transform=transform,
+            drop_last=False, transform=normalize,
         )
         return DataBundle(
             train=train_loader,

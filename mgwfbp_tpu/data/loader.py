@@ -94,12 +94,21 @@ class ShardedLoader:
             nb = len(idx) // self.batch_size
         else:
             nb = (len(idx) + self.batch_size - 1) // self.batch_size
+        wants_rng = getattr(self.transform, "wants_rng", False)
         for b in range(nb):
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
             x = _gather(self.dataset.data, sel)
             y = self.dataset.labels[sel]
             if self.transform is not None:
-                x = self.transform(x)
+                if wants_rng:
+                    # per-(seed, epoch, rank, batch) stream: augmentation is
+                    # deterministic per epoch and decorrelated across ranks
+                    rng = np.random.default_rng(
+                        [self.seed, self.epoch, self.shard.rank, b]
+                    )
+                    x = self.transform(x, rng)
+                else:
+                    x = self.transform(x)
             yield x, y
 
 

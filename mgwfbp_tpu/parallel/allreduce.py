@@ -84,24 +84,62 @@ def merged_psum(
     axis_name: str | tuple[str, ...],
     mean: bool = True,
     comm_dtype: Optional[Any] = None,
+    compressor: Optional[Any] = None,
+    sequential: bool = True,
 ) -> Any:
     """All-reduce a gradient pytree group-by-group per the bucket layout.
 
     Must be called inside shard_map/pmap with `axis_name` bound. `comm_dtype`
     optionally casts buckets for the wire (the reference's FP16 path,
     distributed_optimizer.py:398-399 / settings.FP16) and casts back.
+    `compressor` (parallel.compression) swaps the dense pmean for a sparse
+    top-k allgather per bucket (reference --compressor seam).
+
+    `sequential=True` threads a dataflow token from each group's reduced
+    bucket into the next group's input. This does two load-bearing things:
+      1. It IS the MG-WFBP comm model: the solver's recurrence
+         taoc[l] = max(taoc[l+1] + tc[l+1], taob[l] + tb[l]) (reference
+         distributed_optimizer.py:187-192) assumes collectives execute one
+         at a time in arrival order — the token chain makes XLA honor that
+         order while leaving comm free to overlap BACKWARD COMPUTE.
+      2. It stops XLA's AllReduceCombiner from re-merging the buckets into
+         one giant collective (combining across a dependency is illegal).
+         That pass is the XLA analogue of Horovod's fusion buffer, which
+         the reference explicitly zeroes so MG-WFBP alone controls merging
+         (reference dist_trainer.py:16-17, HOROVOD_FUSION_THRESHOLD=0).
+    The token rides as `+ 0.0 * where(isfinite(t), t, 0)`: XLA cannot fold
+    `0*x` (IEEE: 0*x is not 0 for NaN/inf) and has no finiteness range
+    analysis to see through the `where`, so the dependency survives every
+    simplifier pass — while the `where` guarantees a NaN/inf in one bucket
+    never leaks into later buckets' gradients. The add fuses into the
+    bucket pack — one fused elementwise pass, no extra HBM round-trip.
+    (`lax.optimization_barrier` would be cleaner but is dropped by the SPMD
+    partitioner on at least the CPU backend — verified empirically; the
+    combiner then re-merges everything.)
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arr = [leaves[j] for j in perm]
     shapes = [l.shape for l in arr]
     out: list[Any] = [None] * len(arr)
     axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    token = None
     for gi in range(layout.num_groups):
         buf = buckets_lib.pack_group(arr, layout, gi)
         orig_dtype = buf.dtype
         if comm_dtype is not None and buf.dtype != comm_dtype:
             buf = buf.astype(comm_dtype)
-        buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
+        if sequential and token is not None and jnp.issubdtype(
+            buf.dtype, jnp.inexact
+        ):
+            clean = jnp.where(
+                jnp.isfinite(token), token, jnp.zeros_like(token)
+            )
+            buf = buf + jnp.zeros((), buf.dtype) * clean.astype(buf.dtype)
+        if compressor is not None and jnp.issubdtype(buf.dtype, jnp.floating):
+            buf = compressor.allreduce(buf, axes, mean)
+        else:
+            buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
+        token = buf[0]
         if buf.dtype != orig_dtype:
             buf = buf.astype(orig_dtype)
         for i, a in buckets_lib.unpack_group(buf, layout, gi, shapes).items():
@@ -127,6 +165,8 @@ class MergedAllreduce:
     axis_name: str | tuple[str, ...]
     mean: bool = True
     comm_dtype: Optional[Any] = None
+    compressor: Optional[Any] = None
+    sequential: bool = True
 
     def __call__(self, grads: Any) -> Any:
         return merged_psum(
@@ -136,6 +176,8 @@ class MergedAllreduce:
             self.axis_name,
             mean=self.mean,
             comm_dtype=self.comm_dtype,
+            compressor=self.compressor,
+            sequential=self.sequential,
         )
 
 
@@ -151,6 +193,7 @@ def make_merged_allreduce(
     names: Optional[Sequence[str]] = None,
     mean: bool = True,
     comm_dtype: Optional[Any] = None,
+    compressor: Optional[Any] = None,
 ) -> MergedAllreduce:
     """Build the merged-allreduce transform for a parameter pytree.
 
@@ -182,9 +225,20 @@ def make_merged_allreduce(
         for nm, l in zip(names_arr, arr)
     ]
     if policy == "mgwfbp" and tb is None:
-        total = float(sum(s.size for s in specs)) or 1.0
-        # crude prior: backward time proportional to parameter volume
-        tb = [1e-3 * s.size / total for s in specs]
+        # Fallback prior when no measured profile exists: SHAPE from
+        # parameter volume, SCALE from the cost model — total backward time
+        # taken as the predicted time to all-reduce the whole model once
+        # (the regime where merging decisions matter; if compute is far
+        # cheaper than comm the solver converges to one group, if far more
+        # expensive to per-layer groups — both safe). A measured tb
+        # (Trainer._profile_backward) always takes precedence.
+        total_size = float(sum(s.size for s in specs)) or 1.0
+        total_bytes = float(sum(s.nbytes for s in specs))
+        if cost_model is not None:
+            tb_total = float(cost_model.predict(total_bytes))
+        else:
+            tb_total = 1e-3  # last-resort scale, no information available
+        tb = [tb_total * s.size / total_size for s in specs]
     schedule = build_schedule(
         specs, tb, policy=policy, cost_model=cost_model, threshold=threshold
     )
@@ -211,4 +265,5 @@ def make_merged_allreduce(
         axis_name=axis_name,
         mean=mean,
         comm_dtype=comm_dtype,
+        compressor=compressor,
     )

@@ -160,6 +160,36 @@ def lookup_alpha_beta(connection: str, nworkers: int) -> AlphaBeta:
     return AlphaBeta(alpha=float(a), beta=float(b))
 
 
+# ---------------------------------------------------------------------------
+# Sparsification cost models (reference utils.py:95-117): price the top-k
+# select and the sparse allgather so a policy layer can decide dense vs
+# sparse per merge group. The reference's machine constant s is the per-
+# element*log(element) top-k cost of its P102-100 GPU (utils.py:62); TPU
+# calibration would refit it, the form is hardware-agnostic.
+# ---------------------------------------------------------------------------
+
+TOPK_MACHINE_CONST = 2.18896957e-10  # reference utils.py:62 (P102-100)
+
+
+def topk_time(nelems: float, s: float = TOPK_MACHINE_CONST) -> float:
+    """t = s * n * log2(n): top-k selection cost (reference utils.py:95-102)."""
+    n = max(float(nelems), 2.0)
+    return s * n * float(np.log2(n))
+
+
+def sparse_allgather_time(
+    alpha: float, beta: float, nelems: float, nworkers: int,
+    density: float, itemsize: int = 4,
+) -> float:
+    """t = 2 * (alpha + beta * n * P * itemsize * density): cost of
+    all-gathering (values, indices) of a density-sparsified n-element
+    tensor over P workers (reference allgather_perf_model, utils.py:104-117;
+    the factor 2 covers the value and index payloads)."""
+    return 2.0 * (
+        alpha + beta * float(nelems) * nworkers * itemsize * density
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class TwoLevelAlphaBeta:
     """Two-level (ICI within a slice + DCN across slices) cost model.

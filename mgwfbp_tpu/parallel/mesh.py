@@ -8,7 +8,10 @@ dl_trainer.py:193-198).
 
 Axes:
   data  — data parallelism (the reference's entire parallelism model)
-  seq   — optional sequence/context parallelism (ring attention)
+  seq   — sequence/context parallelism axis; consumed by
+          `parallel.ringattn` (ring attention over ppermute). The reference
+          has no sequence parallelism (SURVEY.md §5 "Long-context") — this
+          axis is the TPU-native long-context extension.
 """
 
 from __future__ import annotations

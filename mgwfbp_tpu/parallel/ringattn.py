@@ -1,0 +1,141 @@
+"""Ring attention: sequence/context parallelism over the `seq` mesh axis.
+
+The reference framework is pure data-parallel — its only sequence machinery
+is single-device BPTT and padded audio batches (SURVEY.md §5
+"Long-context") — so this module is the TPU-native long-context extension
+the seq axis exists for. The design is the standard ring schedule
+(Liu et al., Ring Attention; blockwise online softmax):
+
+  * the sequence dimension is sharded over SEQ_AXIS: each device holds one
+    contiguous block of Q, K, V;
+  * Q stays resident; K/V blocks rotate around the ring via `lax.ppermute`
+    (one ICI hop per step, P-1 steps), each step accumulating its partial
+    attention with numerically-stable online-softmax merging (m, l, acc);
+  * compute of step i overlaps the permute bringing step i+1's K/V — the
+    same latency-hiding XLA applies to the MG-WFBP buckets.
+
+Memory per device is O(T_local^2 / P) score blocks instead of O(T^2): with
+P devices the attainable context length scales linearly in P at fixed HBM.
+
+Causal masking is by global position: device d's queries occupy positions
+[d*T_local, (d+1)*T_local); after i rotations its resident K/V block
+originated at ring neighbour (d - i) mod P.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mgwfbp_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30  # finite mask value: keeps exp()-arithmetic NaN-free
+
+
+def _block_attention(q, k, v, mask, scale):
+    """One (Q-block x K-block) attention partial.
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D), mask: (Tq, Tk) bool (True = keep).
+    Returns (partial_acc (B, Tq, H, D), row_max (B, H, Tq), row_sum)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # (B, H, Tq)
+    # rows with no visible keys: keep exp at 0, not exp(-inf - -inf)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return acc, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring self-attention over a sequence-sharded (B, T_local, H, D) shard.
+
+    Must run inside shard_map with `axis_name` bound; T_global = T_local * P.
+    Returns the attention output shard (B, T_local, H, D).
+    """
+    p_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    q_pos = my * t_local + jnp.arange(t_local)  # global query positions
+
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def partial_step(i, k_cur, v_cur):
+        src = (my - i) % p_size  # ring origin of the resident K/V block
+        k_pos = src * t_local + jnp.arange(t_local)
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = jnp.ones((t_local, t_local), bool)
+        return _block_attention(q, k_cur, v_cur, mask, scale)
+
+    def merge(acc, m, l, part, m_i, l_i):
+        # online-softmax merge of (acc, m, l) with the new partial
+        m_new = jnp.maximum(m, m_i)
+        a_old = jnp.exp(m - m_new)
+        a_new = jnp.exp(m_i - m_new)
+        l = l * a_old + l_i * a_new
+        acc = (
+            acc * jnp.moveaxis(a_old, 1, -1)[..., None]
+            + part * jnp.moveaxis(a_new, 1, -1)[..., None]
+        )
+        return acc, m_new, l
+
+    def step(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        # rotate FIRST (steps 1..p-1), so exactly p-1 rotations happen and
+        # the last block's K/V is never pointlessly sent around the ring
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        acc, m, l = merge(acc, m, l, *partial_step(i, k_cur, v_cur))
+        return acc, m, l, k_cur, v_cur
+
+    b, _, h, d = q.shape
+    acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    # step 0: resident K/V, no rotation
+    k32, v32 = k.astype(jnp.float32), v.astype(jnp.float32)
+    acc, m, l = merge(acc0, m0, l0, *partial_step(0, k32, v32))
+    acc, m, l, _, _ = lax.fori_loop(
+        1, p_size, step, (acc, m, l, k32, v32)
+    )
+    l_q = jnp.moveaxis(l, 1, -1)[..., None]  # (B, Tq, H, 1)
+    out = acc / jnp.maximum(l_q, 1e-30)
+    return out.astype(q.dtype)
+
+
+def local_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True, scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-device reference semantics of `ring_attention` (full sequence
+    resident). Used by tests and as the seq=1 fast path."""
+    t = q.shape[1]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    pos = jnp.arange(t)
+    mask = (
+        pos[None, :] <= pos[:, None]
+        if causal
+        else jnp.ones((t, t), bool)
+    )
+    acc, m, l = _block_attention(
+        q, k.astype(jnp.float32), v.astype(jnp.float32), mask, scale
+    )
+    l_q = jnp.moveaxis(l, 1, -1)[..., None]
+    return (acc / jnp.maximum(l_q, 1e-30)).astype(q.dtype)

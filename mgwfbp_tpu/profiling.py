@@ -13,12 +13,17 @@ parts"), so
   * the comm profiler times REAL `lax.pmean` collectives of each size inside
     a tiny jitted shard_map program (block_until_ready timing), then fits
     alpha-beta with the closed-form least squares from costmodel;
-  * layer-wise backward durations are estimated by measuring the true total
-    backward time and distributing it over arrival-ordered gradient leaves
-    proportionally to an analytic per-leaf backward-cost weight (parameter
-    volume — the dominant term for conv/dense layers). The merge solver is
-    explicitly tolerant of approximate tb (it only compares arrival gaps
-    against alpha); measured totals anchor the scale, which is what matters.
+  * layer-wise backward durations are MEASURED by profiler-trace
+    attribution (`trace_layerwise_backward`): one `jax.profiler.trace` of
+    the jitted backward, device op durations mapped to gradient leaves via
+    the jax name-stack scopes XLA preserves in op metadata (the TPU answer
+    to the reference's per-parameter hook timestamps, profiling.py:31-48);
+    per-scope time splits among a scope's leaves by parameter volume, the
+    unattributed residual is spread by the volume prior, and the sum is
+    normalized to the measured total backward wall-clock;
+  * when tracing yields nothing attributable (exotic backends), the
+    fallback distributes the measured TOTAL by the volume prior alone
+    (`benchmark_backward`) — measured scale, approximate shape.
 """
 
 from __future__ import annotations
@@ -139,6 +144,124 @@ def benchmark_backward(
     return [float(total * w) for w in weights]
 
 
+def _leaf_scopes(names: Sequence[str]) -> list[str]:
+    """Leaf key-path -> flax module scope string as it appears in jax name
+    stacks: "['Block_1']['Conv_0']['kernel']" -> "Block_1/Conv_0"."""
+    import re as _re
+
+    scopes = []
+    for nm in names:
+        parts = _re.findall(r"\['([^']+)'\]", nm) or [nm]
+        scopes.append("/".join(parts[:-1]) if len(parts) > 1 else parts[0])
+    return scopes
+
+
+def _trace_events(logdir: str) -> list[tuple[str, float]]:
+    """(identifier, duration_us) of complete events in a jax profiler trace
+    dir; identifier concatenates the event name with its args (the full
+    jax/XLA metadata lives in either, depending on backend)."""
+    import glob
+    import gzip
+    import json
+    import os
+
+    rows: list[tuple[str, float]] = []
+    for p in glob.glob(
+        os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz")
+    ):
+        with gzip.open(p, "rt") as f:
+            data = json.load(f)
+        for e in data.get("traceEvents", []):
+            if e.get("ph") == "X" and "dur" in e:
+                ident = e.get("name", "")
+                args = e.get("args")
+                if isinstance(args, dict):
+                    ident += " " + " ".join(str(v) for v in args.values())
+                rows.append((ident, float(e["dur"])))
+    return rows
+
+
+def trace_layerwise_backward(
+    grad_fn: Callable,
+    params: Any,
+    names: Sequence[str],
+    perm: Sequence[int],
+    iters: int = 5,
+    logdir: Optional[str] = None,
+) -> Optional[list[float]]:
+    """Measure per-leaf backward durations from a profiler trace.
+
+    grad_fn(params) must be the jitted backward (already warmed up). Returns
+    tb in ARRIVAL order (perm applied), normalized so sum(tb) equals the
+    measured wall-clock total, or None when the trace has no attributable
+    events (caller falls back to the volume prior).
+
+    The reference timestamps each gradient's arrival from an autograd hook
+    (reference profiling.py:31-48, 70-89); here the per-layer times come
+    from the device timeline instead: every op XLA compiled from a module's
+    forward carries that module's name-stack scope in its metadata, and the
+    backward ops carry the same scope under `transpose(jvp(...))`.
+    """
+    import shutil
+    import tempfile
+
+    own = logdir is None
+    logdir = logdir or tempfile.mkdtemp(prefix="mgwfbp_tb_trace_")
+    total = measure_step_time(grad_fn, params, warmup=0, iters=iters)
+    try:
+        with jax.profiler.trace(logdir):
+            out = None
+            for _ in range(iters):
+                out = grad_fn(params)
+            jax.block_until_ready(out)
+        rows = _trace_events(logdir)
+    finally:
+        if own:
+            shutil.rmtree(logdir, ignore_errors=True)
+    if not rows:
+        return None
+    scopes = _leaf_scopes(names)
+    scope_set = sorted(set(scopes), key=len, reverse=True)  # longest first
+    # prefer explicit backward events; fall back to any scope-tagged event
+    bwd = [r for r in rows if "transpose" in r[0]]
+    pool = bwd if bwd else rows
+    scope_time: dict[str, float] = {}
+    for ident, dur in pool:
+        for sc in scope_set:
+            if sc and sc in ident:
+                scope_time[sc] = scope_time.get(sc, 0.0) + dur
+                break
+    if not scope_time:
+        return None
+    leaves = jax.tree_util.tree_leaves(params)
+    vol = [float(np.prod(leaves[j].shape)) or 1.0 for j in range(len(leaves))]
+    # split each scope's time among its leaves by volume
+    per_leaf = np.zeros(len(leaves))
+    for sc, t in scope_time.items():
+        members = [i for i, s in enumerate(scopes) if s == sc]
+        if not members:
+            continue
+        w = np.asarray([vol[i] for i in members])
+        w = w / w.sum()
+        for i, wi in zip(members, w):
+            per_leaf[i] += t * wi
+    attributed = per_leaf.sum()
+    if attributed <= 0:
+        return None
+    # unmatched leaves get the residual of the measured total, spread by
+    # volume; then normalize the whole vector to the measured total
+    missing = [i for i in range(len(leaves)) if per_leaf[i] == 0.0]
+    per_leaf = per_leaf / attributed  # relative shares of traced time
+    if missing:
+        mvol = np.asarray([vol[i] for i in missing])
+        share = float(mvol.sum()) / float(np.sum(vol))
+        per_leaf *= 1.0 - share
+        for i, w in zip(missing, mvol / mvol.sum()):
+            per_leaf[i] = share * w
+    tb_fwd = per_leaf * total
+    return [float(tb_fwd[j]) for j in perm]
+
+
 def benchmark_trainer_backward(
     model: Any,
     meta: Any,
@@ -148,9 +271,15 @@ def benchmark_trainer_backward(
     perm: Sequence[int],
     warmup: int = 5,
     iters: int = 50,
+    names: Optional[Sequence[str]] = None,
 ) -> list[float]:
-    """benchmark(trainer) parity (reference profiling.py:95-147): time the
-    model's full backward on one device and return arrival-ordered tb."""
+    """benchmark(trainer) parity (reference profiling.py:95-147): measure
+    the model's backward on one device and return arrival-ordered tb.
+
+    With `names` (leaf key paths) the per-layer times come from profiler-
+    trace attribution (`trace_layerwise_backward` — truly measured, like the
+    reference's hook timestamps); otherwise, or when the trace yields
+    nothing, the measured TOTAL is distributed by the volume prior."""
     from mgwfbp_tpu.train.step import make_loss_fn
 
     loss_fn = make_loss_fn(model, meta)
@@ -163,6 +292,15 @@ def benchmark_trainer_backward(
         loss, _ = loss_fn(p, batch_stats, batch, rng, carry)
         return loss
 
+    if names is not None:
+        grad_fn = jax.jit(lambda p: jax.grad(scalar_loss)(p, example_batch))
+        for _ in range(max(warmup, 1)):
+            jax.block_until_ready(grad_fn(params))
+        tb = trace_layerwise_backward(
+            grad_fn, params, names, perm, iters=iters
+        )
+        if tb is not None:
+            return tb
     return benchmark_backward(
         scalar_loss, params, (example_batch,), perm, warmup=warmup, iters=iters
     )

@@ -13,8 +13,10 @@ This is the TPU answer to the reference's hot loop (SURVEY.md §3.1):
   * the merge schedule (solver) controls collective granularity, trading
     startup latency alpha against overlap, exactly as in the paper;
   * gradient accumulation (`nsteps_update`, reference dist_trainer.py:77-88)
-    is a `lax.scan` over micro-batches with communication only after the
-    last micro-step (parity with `optimizer.local=True` skipping hooks);
+    is a `lax.scan` over the first n-1 micro-batches with the FINAL
+    micro-step peeled out of the loop, so the merged collectives can
+    overlap its backward (parity with `optimizer.local=True` skipping
+    hooks on non-final steps and the hooks firing during the last one);
   * the optimizer chain (incl. norm clipping AFTER reduction, reference
     dist_trainer.py:89-94) runs replicated on every device.
 
@@ -169,31 +171,57 @@ def make_train_step(
         step_rng = jax.random.fold_in(state.rng, state.step)
         # decorrelate dropout across data-parallel members
         step_rng = jax.random.fold_in(step_rng, lax.axis_index(axis_name))
+        g_fn = jax.grad(loss_fn, has_aux=True)
+
+        def micro_grads(bstats, mcarry, micro_batch, micro_idx):
+            # distinct dropout mask per micro-step
+            micro_rng = jax.random.fold_in(step_rng, micro_idx)
+            return g_fn(state.params, bstats, micro_batch, micro_rng, mcarry)
 
         def micro(acc, xs):
             micro_batch, micro_idx = xs
             grads_sum, bstats, mcarry, metrics_sum = acc
-            g_fn = jax.grad(loss_fn, has_aux=True)
-            # distinct dropout mask per micro-step
-            micro_rng = jax.random.fold_in(step_rng, micro_idx)
-            grads, (bstats, mcarry, metrics) = g_fn(
-                state.params, bstats, micro_batch, micro_rng, mcarry
+            grads, (bstats, mcarry, metrics) = micro_grads(
+                bstats, mcarry, micro_batch, micro_idx
             )
             grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
             metrics_sum = jax.tree_util.tree_map(jnp.add, metrics_sum, metrics)
             return (grads_sum, bstats, mcarry, metrics_sum), None
 
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-        zero_metrics = {
-            "loss": jnp.zeros(()),
-            **({"accuracy": jnp.zeros(())} if meta.task == "classify" else {}),
-            **({"perplexity": jnp.zeros(())} if meta.task == "lm" else {}),
-        }
-        (grads, bstats, new_carry, metrics), _ = lax.scan(
-            micro,
-            (zeros, state.batch_stats, carry, zero_metrics),
-            (batch, jnp.arange(nsteps_update)),
-        )
+        # The final micro-step's backward is NEVER inside a lax.scan: a scan
+        # is a dataflow barrier (no collective consuming its outputs can
+        # start before the loop op completes), which would serialize ALL
+        # merged pmeans after ALL backward compute and kill the overlap
+        # MG-WFBP exists for. The reference overlaps allreduces with the
+        # final accumulation step's backward (hooks fire during it,
+        # dist_trainer.py:77-94); peeling the last micro-step reproduces
+        # exactly that: group k's pmean depends only on group k's grads
+        # from the peeled backward, so XLA's latency-hiding scheduler can
+        # issue it while earlier layers' grads are still being computed.
+        if nsteps_update == 1:
+            last_batch = jax.tree_util.tree_map(lambda v: v[0], batch)
+            grads, (bstats, new_carry, metrics) = micro_grads(
+                state.batch_stats, carry, last_batch, jnp.int32(0)
+            )
+        else:
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zero_metrics = {
+                "loss": jnp.zeros(()),
+                **({"accuracy": jnp.zeros(())} if meta.task == "classify" else {}),
+                **({"perplexity": jnp.zeros(())} if meta.task == "lm" else {}),
+            }
+            head = jax.tree_util.tree_map(lambda v: v[:-1], batch)
+            (grads_sum, bstats, mcarry, metrics_sum), _ = lax.scan(
+                micro,
+                (zeros, state.batch_stats, carry, zero_metrics),
+                (head, jnp.arange(nsteps_update - 1)),
+            )
+            last_batch = jax.tree_util.tree_map(lambda v: v[-1], batch)
+            grads, (bstats, new_carry, metrics) = micro_grads(
+                bstats, mcarry, last_batch, jnp.int32(nsteps_update - 1)
+            )
+            grads = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
+            metrics = jax.tree_util.tree_map(jnp.add, metrics_sum, metrics)
         inv = 1.0 / float(nsteps_update)
         grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics)
@@ -273,7 +301,10 @@ def make_eval_step(
 
     def per_device(state: TrainState, batch, carry):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
-        valid = batch["valid"]  # (local_batch,) float, 1.0 = real sample
+        if "valid" in batch:
+            valid = batch["valid"]  # (local_batch,) float, 1.0 = real sample
+        else:  # unpadded batch: every sample counts
+            valid = jnp.ones((batch["x"].shape[0],), jnp.float32)
         count = valid.sum()
         if meta.task == "classify":
             logits = model.apply(variables, batch["x"], train=False)

@@ -86,6 +86,7 @@ class Trainer:
             seed=config.seed,
             image_hw=image_hw,
             synthetic=synthetic_data,
+            augment=config.augment,
         )
         if self.bundle.num_classes != self.meta.num_classes:
             self.model, self.meta = zoo.create_model(
@@ -165,6 +166,14 @@ class Trainer:
         comm_dtype = (
             jnp.dtype(cfg.comm_dtype) if cfg.comm_dtype else None
         )
+        from mgwfbp_tpu.parallel.compression import make_compressor
+
+        compressor = make_compressor(cfg.compressor, cfg.density)
+        if compressor is not None:
+            self.log.info(
+                "gradient compression: %s density=%g",
+                cfg.compressor, cfg.density,
+            )
         return make_merged_allreduce(
             self.state.params,
             axis_name=DATA_AXIS,
@@ -173,6 +182,7 @@ class Trainer:
             cost_model=cost_model,
             threshold=cfg.threshold,
             comm_dtype=comm_dtype,
+            compressor=compressor,
         )
 
     def _profile_backward(self) -> Optional[list[float]]:
@@ -198,8 +208,9 @@ class Trainer:
         t0 = time.perf_counter()
         tb = benchmark_trainer_backward(
             self.model, self.meta, self.state.params, self.state.batch_stats,
-            batch, perm, warmup=2, iters=10,
+            batch, perm, warmup=2, iters=10, names=names,
         )
+        self._persist_tb(tb, names, perm)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
@@ -213,6 +224,29 @@ class Trainer:
         )
         return tb
 
+    def _persist_tb(self, tb, names, perm) -> None:
+        """Persist the measured layer-wise backward profile next to the run's
+        logs (the comm profile's sibling — reference persists nothing, but
+        its measured layerwise_times are the solver's primary input,
+        dist_trainer.py:44-51, so ours are auditable on disk)."""
+        if not self.config.logdir:
+            return
+        import json
+
+        path = os.path.join(
+            self.config.logdir, self.config.tag(), "tb_profile.json"
+        )
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "tb_s": tb,
+                    "arrival_names": [names[j] for j in perm],
+                    "total_s": sum(tb),
+                },
+                f,
+            )
+
     def _peek_batch(self) -> dict:
         self.bundle.train.set_epoch(0)
         it = iter(self.bundle.train)
@@ -224,6 +258,13 @@ class Trainer:
             return {k: jnp.asarray(v) for k, v in raw.items()}
         x, y = raw
         return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+    def _to_host_batch(self, raw) -> dict:
+        """Batch dict as HOST numpy arrays (for pre-device-put padding)."""
+        if isinstance(raw, dict):
+            return {k: np.asarray(v) for k, v in raw.items()}
+        x, y = raw
+        return {"x": np.asarray(x), "y": np.asarray(y)}
 
     def _stack_micro(self, batches: list[dict]) -> dict:
         """Stack nsteps_update micro-batches on a leading scan axis, then
@@ -294,6 +335,14 @@ class Trainer:
                 )
                 t_window = time.time()
                 window_iters = 0
+        if micro:
+            # trailing micro-batches short of a full nsteps_update group are
+            # dropped; say so (SURVEY "no silent caps")
+            self.log.info(
+                "epoch %d: dropped %d trailing micro-batch(es) "
+                "(loader length %% nsteps_update=%d != 0)",
+                epoch, len(micro), nsteps,
+            )
         metrics = {k: float(v) for k, v in metrics.items()}
         self.log.info(
             "epoch %d done in %.1f s (lr %.5f)",
@@ -303,10 +352,17 @@ class Trainer:
         return metrics
 
     def evaluate(self) -> dict:
-        """Eval over the val loader (reference test(), dl_trainer.py:854-937)."""
+        """Eval over the val loader (reference test(), dl_trainer.py:854-937).
+
+        Every sample is evaluated — the reference iterates the full val set —
+        so an indivisible tail batch is PADDED up to data-axis divisibility
+        (edge-replicating real samples) with a per-sample ``valid`` mask
+        zeroing the padding's contribution. `eval_step` returns psum'd GLOBAL
+        sums per metric plus ``count``; accumulation here is plain addition
+        and one final divide by the summed count.
+        """
         loader = self.bundle.val
         sums: dict[str, float] = {}
-        count = 0
         carry = (
             self._globalize(
                 self.model.initial_carry(self.process_batch), axes=0
@@ -314,39 +370,78 @@ class Trainer:
             if self.meta.has_carry
             else None
         )
+        # each process's local batch must split evenly over its local extent
+        # of the data axis for the global assembly to shard cleanly
+        quantum = max(self.data_size // jax.process_count(), 1)
         for raw in loader:
-            batch = self._globalize(self._to_model_batch(raw), axes=0)
+            batch = self._to_host_batch(raw)
             b = next(iter(batch.values())).shape[0]
-            if b % self.data_size != 0:
-                continue  # remainder batch not shardable; skip (small tail)
             if self.meta.has_carry:
-                if b != self.process_batch * jax.process_count():
+                # carry pins the batch extent; loaders for carry models use
+                # drop_last so every batch is full-size already
+                target = self.process_batch
+                if b != target:
+                    self.log.warning(
+                        "evaluate: skipping %d-sample batch (carry model "
+                        "requires fixed batch %d)", b, target,
+                    )
                     continue
+            else:
+                target = -(-b // quantum) * quantum
+            valid = np.ones((b,), np.float32)
+            if b < target:
+                # pad on the HOST (edge-replicate) before any device put
+                pad = target - b
+                batch = {
+                    k: np.concatenate(
+                        [v, np.repeat(v[:1], pad, axis=0)], axis=0
+                    )
+                    for k, v in batch.items()
+                }
+                valid = np.concatenate([valid, np.zeros((pad,), np.float32)])
+            batch["valid"] = valid
+            batch = self._globalize(
+                {k: jnp.asarray(v) for k, v in batch.items()}, axes=0
+            )
+            if self.meta.has_carry:
                 metrics, carry = self.eval_step(self.state, batch, carry)
             else:
                 metrics = self.eval_step(self.state, batch)
             for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v) * b
-            count += b
-        out = {k: v / max(count, 1) for k, v in sums.items()}
+                sums[k] = sums.get(k, 0.0) + float(v)
+        count = sums.pop("count", 0.0)
+        out = {k: v / max(count, 1.0) for k, v in sums.items()}
+        out["count"] = count
+        if self.meta.task == "lm":
+            # reference reports per-token perplexity (dl_trainer.py:927-929)
+            out["perplexity"] = float(np.exp(out.get("loss", 0.0)))
         if self.meta.task == "ctc":
             out.update(self._evaluate_wer())
         return out
 
-    def _evaluate_wer(self, max_batches: int = 8) -> dict:
-        """Host-side greedy decode + WER on a val subset (reference
-        dl_trainer.py:891-910)."""
+    def _evaluate_wer(self, max_batches: Optional[int] = None) -> dict:
+        """Host-side greedy decode + WER over the FULL validation set
+        (reference dl_trainer.py:891-910 decodes every val batch);
+        max_batches caps it for smoke runs only."""
         from mgwfbp_tpu.data.audio import greedy_decode, ids_to_text, wer
 
+        if not hasattr(self, "_decode_forward"):
+            # jitted decode forward — eager per-op dispatch of the conv+RNN
+            # stack is orders of magnitude slower than one compiled call
+            self._decode_forward = jax.jit(
+                lambda params, bstats, x, lens: self.model.apply(
+                    {"params": params, "batch_stats": bstats},
+                    x, lens, train=False,
+                )
+            )
         total, n = 0.0, 0
         for bi, raw in enumerate(self.bundle.val):
-            if bi >= max_batches:
+            if max_batches is not None and bi >= max_batches:
                 break
             batch = self._to_model_batch(raw)
-            logits, out_lengths = self.model.apply(
-                {"params": self.state.params,
-                 "batch_stats": self.state.batch_stats},
-                batch["x"], batch["input_lengths"], train=False,
+            logits, out_lengths = self._decode_forward(
+                self.state.params, self.state.batch_stats,
+                batch["x"], batch["input_lengths"],
             )
             hyps = greedy_decode(np.asarray(logits), np.asarray(out_lengths))
             for j, hyp in enumerate(hyps):
@@ -407,18 +502,22 @@ class Trainer:
             )
             return
         if self.config.pretrain:
-            # --pretrain initializes WEIGHTS from another run (reference
-            # dist_trainer.py:32-39); counters and optimizer state start
-            # fresh so fine-tuning actually trains (a full resume of the
-            # same run goes through checkpoint_dir instead)
+            # --pretrain initializes weights AND epoch/iter counters from
+            # another run (reference dl_trainer.py:307-312 restores
+            # {'state','epoch','iter'}; dist_trainer.py:36-39 broadcasts the
+            # counters). Optimizer state starts fresh — the reference never
+            # saves it.
             pre = self.load_checkpoint(self.config.pretrain)
             self.state = self.state.replace(
+                step=pre.state.step,
                 params=pre.state.params,
                 batch_stats=pre.state.batch_stats,
             )
+            self.start_epoch = pre.epoch + 1
+            self.iteration = pre.iteration
             self.log.info(
-                "initialized weights from pretrain dir %s (epoch %d)",
-                self.config.pretrain, pre.epoch,
+                "initialized from pretrain dir %s (epoch %d, iter %d)",
+                self.config.pretrain, pre.epoch, pre.iteration,
             )
 
     def fit(self, num_epochs: Optional[int] = None) -> dict:

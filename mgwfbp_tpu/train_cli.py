@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-parallel", dest="seq_parallel", type=int, default=None)
     p.add_argument("--synthetic", action="store_true",
                    help="force synthetic data (no dataset files needed)")
+    p.add_argument("--no-augment", action="store_true",
+                   help="disable training-time data augmentation")
+    p.add_argument("--compressor", default=None,
+                   choices=["none", "topk"],
+                   help="gradient compressor (reference --compressor)")
+    p.add_argument("--density", type=float, default=None,
+                   help="kept-fraction for sparsifying compressors")
     p.add_argument("--no-profile-backward", action="store_true",
                    help="skip the offline backward benchmark (size prior)")
     p.add_argument("--epochs", type=int, default=None,
@@ -80,9 +87,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "nsteps_update", "policy", "threshold", "connection",
             "comm_profile", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
+            "compressor", "density",
         )
         if getattr(args, k, None) is not None
     }
+    if args.no_augment:
+        overrides["augment"] = False
     return make_config(args.dnn, **overrides)
 
 

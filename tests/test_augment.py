@@ -1,0 +1,107 @@
+"""Augmentation unit tests (VERDICT r2 task #6; reference
+dl_trainer.py:331-336 ImageNet RandomResizedCrop+flip, :381-385 CIFAR
+RandomCrop(32, pad=4)+flip)."""
+
+import numpy as np
+import pytest
+
+from mgwfbp_tpu.data.augment import (
+    Augment,
+    chain,
+    random_crop,
+    random_hflip,
+    random_resized_crop,
+    train_augment,
+)
+from mgwfbp_tpu.data.loader import ArrayDataset, ShardedLoader
+
+
+def _rng(seed=0):
+    return np.random.default_rng([seed])
+
+
+def test_random_hflip_flips_some_not_all():
+    x = np.arange(8 * 4 * 4 * 1, dtype=np.float32).reshape(8, 4, 4, 1)
+    out = random_hflip(x, _rng(0))
+    flipped = [
+        i for i in range(8) if np.array_equal(out[i], x[i, :, ::-1])
+        and not np.array_equal(out[i], x[i])
+    ]
+    unchanged = [i for i in range(8) if np.array_equal(out[i], x[i])]
+    assert flipped and unchanged
+    assert len(flipped) + len(unchanged) == 8
+
+
+def test_random_crop_preserves_shape_and_content_window():
+    x = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+    out = random_crop(x, _rng(1), pad=4)
+    assert out.shape == x.shape
+    # every output is a translated copy: its interior must appear in the
+    # padded original; cheap check — pixel multiset of the central region
+    # intersects heavily (zero padding enters at most 4 rows/cols)
+    assert np.isin(
+        np.round(out[0, 8:24, 8:24], 5), np.round(x[0], 5)
+    ).mean() > 0.9
+
+
+def test_random_crop_identity_at_zero_offset():
+    x = np.ones((2, 8, 8, 1), np.float32)
+    out = random_crop(x, _rng(2), pad=2)
+    # all-ones image: any crop containing no padding is all ones; padding
+    # introduces zeros only at the borders
+    assert out.shape == x.shape
+    assert set(np.unique(out)).issubset({0.0, 1.0})
+
+
+def test_random_resized_crop_shape_and_range():
+    x = np.random.RandomState(1).rand(3, 32, 32, 3).astype(np.float32)
+    out = random_resized_crop(x, _rng(3))
+    assert out.shape == x.shape
+    assert out.dtype == np.float32
+    # bilinear interpolation cannot exceed the input range
+    assert out.min() >= x.min() - 1e-5 and out.max() <= x.max() + 1e-5
+
+
+def test_train_augment_registry():
+    assert train_augment("cifar10") is not None
+    assert train_augment("imagenet") is not None
+    assert train_augment("mnist") is None
+    assert train_augment("ptb") is None
+
+
+def test_loader_augmentation_deterministic_per_epoch():
+    rs = np.random.RandomState(0)
+    ds = ArrayDataset(
+        rs.rand(64, 8, 8, 1).astype(np.float32),
+        rs.randint(0, 10, 64),
+        10,
+    )
+    aug = Augment(random_crop, random_hflip)
+    loader = ShardedLoader(ds, 16, shuffle=True, seed=7, transform=aug)
+    loader.set_epoch(0)
+    a0 = [x.copy() for x, _ in loader]
+    loader.set_epoch(0)
+    a0b = [x.copy() for x, _ in loader]
+    loader.set_epoch(1)
+    a1 = [x.copy() for x, _ in loader]
+    for u, v in zip(a0, a0b):  # same epoch -> identical augmentation
+        np.testing.assert_array_equal(u, v)
+    assert any(
+        not np.array_equal(u, v) for u, v in zip(a0, a1)
+    )  # different epoch -> different crops/flips
+
+
+def test_chain_mixes_rng_and_plain_transforms():
+    calls = []
+
+    def plain(x):
+        calls.append("plain")
+        return x + 1.0
+
+    aug = Augment(random_hflip)
+    tf = chain(aug, plain)
+    assert tf.wants_rng
+    x = np.zeros((2, 4, 4, 1), np.float32)
+    out = tf(x, _rng(4))
+    assert calls == ["plain"]
+    np.testing.assert_array_equal(out, np.ones_like(x))

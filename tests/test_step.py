@@ -188,8 +188,35 @@ def test_ctc_step_runs(mesh):
 def test_eval_step_top5(mesh):
     model, meta, tx, state, batch = _lenet_setup()
     ev = make_eval_step(model, meta, mesh)
+    # without an explicit mask every sample counts
     metrics = ev(state, {"x": batch["x"][0], "y": batch["y"][0]})
-    assert 0.0 <= float(metrics["top1"]) <= float(metrics["top5"]) <= 1.0
+    n = float(metrics["count"])
+    assert n == batch["x"].shape[1]
+    assert 0.0 <= float(metrics["top1"]) <= float(metrics["top5"]) <= n
+
+
+def test_eval_step_valid_mask_zeroes_padding(mesh):
+    model, meta, tx, state, batch = _lenet_setup()
+    ev = make_eval_step(model, meta, mesh)
+    x, y = batch["x"][0], batch["y"][0]
+    full = ev(state, {"x": x, "y": y})
+    # mask off the back half: sums must equal evaluating the front half alone
+    half = x.shape[0] // 2
+    valid = jnp.concatenate(
+        [jnp.ones((half,)), jnp.zeros((x.shape[0] - half,))]
+    )
+    masked = ev(state, {"x": x, "y": y, "valid": valid})
+    assert float(masked["count"]) == half
+    front = ev(
+        state,
+        {"x": jnp.concatenate([x[:half]] * 2),
+         "y": jnp.concatenate([y[:half]] * 2),
+         "valid": valid},
+    )
+    np.testing.assert_allclose(
+        float(masked["top1"]), float(front["top1"]), rtol=1e-6
+    )
+    assert float(full["count"]) == x.shape[0]
 
 
 def test_decay_mask_excludes_1d():

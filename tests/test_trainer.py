@@ -38,6 +38,7 @@ def test_trainer_end_to_end_mnist(tmp_path):
     assert int(t2.state.step) == int(t.state.step)
 
 
+@pytest.mark.slow
 def test_trainer_policies_same_loss():
     # wfbp / single / none must be numerically identical given same seed
     losses = {}
@@ -48,6 +49,29 @@ def test_trainer_policies_same_loss():
         losses[policy] = m["loss"]
     vals = list(losses.values())
     assert max(vals) - min(vals) < 1e-4, losses
+
+
+def test_evaluate_indivisible_val_set_counts_every_sample():
+    """Val set whose size is NOT divisible by the 8-device data axis: every
+    sample must be evaluated (reference dl_trainer.py:854-937), with top1
+    matching a hand computation over the same samples."""
+    cfg = _cfg()
+    t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    rs = np.random.RandomState(11)
+    n = 21  # 21 % 8 != 0; also indivisible tail within each batch of 8
+    x = rs.randn(n, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, size=(n,)).astype(np.int32)
+    t.bundle.val = [
+        (x[:8], y[:8]), (x[8:16], y[8:16]), (x[16:], y[16:])
+    ]
+    out = t.evaluate()
+    assert out["count"] == n
+    logits = t.model.apply(
+        {"params": t.state.params, "batch_stats": t.state.batch_stats},
+        jnp.asarray(x), train=False,
+    )
+    want_top1 = float((np.argmax(np.asarray(logits), -1) == y).mean())
+    assert out["top1"] == pytest.approx(want_top1, abs=1e-6)
 
 
 def test_trainer_gradient_accumulation_runs():
@@ -82,6 +106,7 @@ def test_trainer_lstm_carry_epoch(monkeypatch):
     assert "perplexity" in ev
 
 
+@pytest.mark.slow
 def test_trainer_ctc_wer_eval(monkeypatch):
     from mgwfbp_tpu import models as zoo
     from mgwfbp_tpu.models import ModelMeta
@@ -114,6 +139,7 @@ def test_cli_print_config(capsys):
     assert out["dataset"] == "cifar10" and out["batch_size"] == 32
 
 
+@pytest.mark.slow
 def test_cli_end_to_end(capsys):
     from mgwfbp_tpu.train_cli import main
 
@@ -152,6 +178,7 @@ def test_benchmark_backward_distributes_total():
     assert tb[1] > tb[0]
 
 
+@pytest.mark.slow
 def test_accumulation_lr_schedule_counts_optimizer_steps():
     # nsteps_update=2 halves optimizer steps per epoch; warmup must still
     # complete in the same number of wall epochs
@@ -170,6 +197,7 @@ def test_accumulation_lr_schedule_counts_optimizer_steps():
     assert lr_after_epoch1 == pytest.approx(float(sched(1.0)))
 
 
+@pytest.mark.slow
 def test_fit_epochs_relative_to_resume(tmp_path):
     cfg = _cfg(checkpoint_dir=str(tmp_path / "c2"))
     t = Trainer(cfg, synthetic_data=True, profile_backward=False)
