@@ -36,12 +36,26 @@ def main(argv: Optional[list[str]] = None) -> int:
     from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
     from mgwfbp_tpu.profiling import profile_allreduce
 
+    import jax
+
     mesh = make_mesh(MeshSpec())
     sizes = tuple(2**k for k in range(args.min_log2, args.max_log2 + 1))
     prof = profile_allreduce(
         mesh, sizes=sizes, warmup=args.warmup, iters=args.iters
     )
-    save_profile(args.out, prof.model)
+    import os
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    save_profile(
+        args.out,
+        prof.model,
+        meta={
+            "device_kind": jax.devices()[0].device_kind,
+            "n_devices": len(jax.devices()),
+            "payload_log2_range": [args.min_log2, args.max_log2],
+            "iters": args.iters,
+        },
+    )
     print(
         json.dumps(
             {

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Mapping, Sequence
+from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -119,6 +119,9 @@ _CONNECTIONS: Mapping[str, Mapping[int, AlphaBeta]] = {
 }
 
 
+_PRIOR_WARNED: set = set()
+
+
 def lookup_alpha_beta(connection: str, nworkers: int) -> AlphaBeta:
     """Resolve an AlphaBeta for a link class and worker count.
 
@@ -127,10 +130,27 @@ def lookup_alpha_beta(connection: str, nworkers: int) -> AlphaBeta:
     counts log2-interpolate between the bracketing entries, larger counts
     extrapolate alpha from the largest entry (ring all-reduce startup grows
     ~linearly in hop count).
+
+    'ici'/'dcn' are UNCALIBRATED fallback priors (order-of-magnitude
+    guesses, including an assumed ~linear alpha-vs-hops growth). Calibrate
+    the real topology with `python -m mgwfbp_tpu.calibrate` and load the
+    profile (--comm-profile / `load_profile`) instead; a one-time warning
+    marks any run still on the prior.
     """
+    if connection in ("ici", "dcn"):
+        if connection not in _PRIOR_WARNED:
+            _PRIOR_WARNED.add(connection)
+            import logging
+
+            logging.getLogger("mgwfbp.costmodel").warning(
+                "using UNCALIBRATED %s alpha-beta prior; run "
+                "`python -m mgwfbp_tpu.calibrate --out profiles/<topo>.json` "
+                "and pass --comm-profile for measured constants",
+                connection,
+            )
     if connection == "ici":
-        # alpha grows with ring hops; beta (algorithm bandwidth) is roughly
-        # size-independent for a bidirectional ring.
+        # prior shape: alpha grows with ring hops; beta (algorithm
+        # bandwidth) roughly size-independent for a bidirectional ring
         ab = _TPU_ICI_DEFAULT
         hops = max(nworkers - 1, 1)
         return AlphaBeta(alpha=ab.alpha * (1.0 + 0.1 * hops), beta=ab.beta)
@@ -221,7 +241,13 @@ class TwoLevelAlphaBeta:
         return self.ici.alpha + self.dcn.alpha
 
 
-def save_profile(path: str, model: AlphaBeta | TwoLevelAlphaBeta) -> None:
+def save_profile(
+    path: str,
+    model: AlphaBeta | TwoLevelAlphaBeta,
+    meta: Optional[dict] = None,
+) -> None:
+    """Persist a calibrated model; `meta` (device kind, mesh, date) is
+    carried for provenance and ignored on load."""
     with open(path, "w") as f:
         if isinstance(model, TwoLevelAlphaBeta):
             json.dump(
@@ -231,17 +257,26 @@ def save_profile(path: str, model: AlphaBeta | TwoLevelAlphaBeta) -> None:
                     "dcn": dataclasses.asdict(model.dcn),
                     "ici_size": model.ici_size,
                     "dcn_size": model.dcn_size,
+                    **({"meta": meta} if meta else {}),
                 },
                 f,
             )
         else:
-            json.dump({"kind": "flat", **dataclasses.asdict(model)}, f)
+            json.dump(
+                {
+                    "kind": "flat",
+                    **dataclasses.asdict(model),
+                    **({"meta": meta} if meta else {}),
+                },
+                f,
+            )
 
 
 def load_profile(path: str) -> AlphaBeta | TwoLevelAlphaBeta:
     with open(path) as f:
         d = json.load(f)
     kind = d.pop("kind", "flat")
+    d.pop("meta", None)
     if kind == "two_level":
         return TwoLevelAlphaBeta(
             ici=AlphaBeta(**d["ici"]),
