@@ -29,6 +29,8 @@ class TrainConfig:
     # distributed
     nworkers: int = 1
     seq_parallel: int = 1  # sequence-parallel mesh extent (TPU extension)
+    num_steps: Optional[int] = None  # LM window length override (default 35;
+    # seq-parallel transformers need num_steps % seq_parallel == 0)
 
     # MG-WFBP scheduler
     policy: str = "mgwfbp"  # mgwfbp | threshold | single | wfbp
@@ -88,6 +90,11 @@ PRESETS: dict[str, dict] = {
     "alexnet": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70),
     "lstm": dict(dataset="ptb", batch_size=20, lr=22.0, max_epochs=40,
                  lr_schedule="ptb", norm_clip=0.25, weight_decay=0.0, momentum=0.9),
+    # TPU long-context extension (no reference analogue): windowed LM with
+    # ring attention; 64-token windows divide by seq extents 2/4/8
+    "transformer": dict(dataset="ptb", batch_size=16, lr=1.0, max_epochs=40,
+                        lr_schedule="cosine", weight_decay=1e-5, momentum=0.9,
+                        num_steps=64),
     "lstman4": dict(dataset="an4", batch_size=4, lr=2e-4, max_epochs=100,
                     lr_schedule="anneal", norm_clip=400.0, weight_decay=0.0),
     "fcn5net": dict(dataset="mnist", batch_size=64, lr=0.05, max_epochs=10),

@@ -60,6 +60,7 @@ def data_prepare(
     image_hw: Optional[tuple[int, int]] = None,
     synthetic: Optional[bool] = None,
     augment: bool = True,
+    num_steps: Optional[int] = None,
 ) -> DataBundle:
     """Build sharded train/val loaders for a dataset name.
 
@@ -67,6 +68,9 @@ def data_prepare(
     `synthetic=True` forces the synthetic twin; None auto-detects files.
     `image_hw` overrides the image size (inceptions need 299x299).
     `augment=False` disables training-time augmentation (benchmarking).
+    `num_steps` overrides the LM window length (default: the reference's
+    35-token BPTT window; seq-parallel transformers need a length divisible
+    by the seq mesh extent).
     """
     name = dataset.lower()
     if name in ("mnist", "cifar10", "imagenet"):
@@ -141,6 +145,7 @@ def data_prepare(
             synthetic_ptb_stream,
         )
 
+        nsteps = num_steps or NUM_STEPS
         streams = None
         if not synthetic:
             streams = (load_ptb_stream(data_dir, "train"),
@@ -160,11 +165,11 @@ def data_prepare(
         # per rank (see ptb.carry_layout); NO shuffling, NO sample-sharding —
         # the carry must see textually consecutive windows each step.
         train = carry_layout(
-            train_stream, NUM_STEPS, batch_size, shard.rank, shard.nranks,
+            train_stream, nsteps, batch_size, shard.rank, shard.nranks,
             vocab_size,
         )
         val = carry_layout(
-            val_stream, NUM_STEPS, batch_size, shard.rank, shard.nranks,
+            val_stream, nsteps, batch_size, shard.rank, shard.nranks,
             vocab_size,
         )
         train_loader = ShardedLoader(train, batch_size, shuffle=False, seed=seed)

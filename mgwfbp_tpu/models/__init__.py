@@ -293,6 +293,21 @@ def _lstm(nc):
     )
 
 
+@register("transformer")
+def _transformer(nc):
+    from mgwfbp_tpu.models.transformer import TransformerLM
+
+    nc = nc or DATASET_CLASSES["ptb"]
+    return (
+        TransformerLM(vocab_size=nc),
+        ModelMeta(
+            name="transformer", dataset="ptb", num_classes=nc,
+            input_shape=(35,), input_dtype=jnp.int32, task="lm",
+            has_carry=False,
+        ),
+    )
+
+
 @register("lstman4")
 def _lstman4(nc):
     from mgwfbp_tpu.models.deepspeech import DeepSpeech

@@ -110,10 +110,17 @@ def make_loss_fn(model: Any, meta: ModelMeta, aux_weight: float = 0.3) -> Callab
             metrics = {"loss": loss, "accuracy": correct}
             return loss, (updates.get("batch_stats", batch_stats), carry, metrics)
         if meta.task == "lm":
-            (logits, new_carry), updates = model.apply(
-                variables, batch["x"], carry=carry, train=True,
-                mutable=["batch_stats"], rngs=rngs,
-            )
+            if meta.has_carry:
+                (logits, new_carry), updates = model.apply(
+                    variables, batch["x"], carry=carry, train=True,
+                    mutable=["batch_stats"], rngs=rngs,
+                )
+            else:  # windowed LM (transformer): no BPTT carry
+                logits, updates = model.apply(
+                    variables, batch["x"], train=True,
+                    mutable=["batch_stats"], rngs=rngs,
+                )
+                new_carry = carry
             loss = cross_entropy(
                 logits.reshape(-1, logits.shape[-1]), batch["y"].reshape(-1)
             )
@@ -150,6 +157,7 @@ def make_train_step(
     *,
     nsteps_update: int = 1,
     axis_name: str = DATA_AXIS,
+    seq_axis: Optional[str] = None,
     donate: bool = True,
 ) -> Callable:
     """Build the jitted sharded train step.
@@ -159,18 +167,37 @@ def make_train_step(
     'single'; true WFBP baseline is policy 'wfbp'; None is "let XLA fuse",
     the ORIGINAL_HOROVOD-style oracle, SURVEY.md §5 config system).
 
+    seq_axis: sequence-parallel mesh axis for lm models whose time dimension
+    is sharded (ring attention, parallel.ringattn). Batch x/y get spec
+    P(None, data, seq); gradients/metrics reduce over BOTH axes (each seq
+    shard computes the loss of its token slice, so the global loss gradient
+    is the mean over data AND seq members). The reducer, when given, must
+    have been built with axis_name=(data, seq).
+
     Returned signature:
       classify/ctc: step(state, batch) -> (state, metrics)
       lm:           step(state, batch, carry) -> (state, metrics, carry)
+      lm without carry (transformer): step(state, batch) -> (state, metrics)
     Batch leaves are (nsteps_update, global_batch, ...); sharded on dim 1.
     """
     loss_fn = make_loss_fn(model, meta)
     has_carry = meta.has_carry
+    if seq_axis is not None and has_carry:
+        raise ValueError(
+            "sequence parallelism is for windowed lm models; BPTT carry "
+            "models shard only the data axis"
+        )
+    red_axes = (
+        (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    )
 
     def per_device(state: TrainState, batch, carry):
         step_rng = jax.random.fold_in(state.rng, state.step)
         # decorrelate dropout across data-parallel members
         step_rng = jax.random.fold_in(step_rng, lax.axis_index(axis_name))
+        if seq_axis is not None:
+            # ...and across sequence shards (different token slices)
+            step_rng = jax.random.fold_in(step_rng, lax.axis_index(seq_axis))
         g_fn = jax.grad(loss_fn, has_aux=True)
 
         def micro_grads(bstats, mcarry, micro_batch, micro_idx):
@@ -229,13 +256,13 @@ def make_train_step(
         if reducer is not None:
             grads = reducer(grads)
         else:
-            grads = lax.pmean(grads, axis_name)
-        metrics = lax.pmean(metrics, axis_name)
+            grads = lax.pmean(grads, red_axes)
+        metrics = lax.pmean(metrics, red_axes)
         # BN running stats: keep replicas identical (the reference leaves
         # them per-GPU; syncing is strictly better and required for the
         # replicated out-spec)
         if jax.tree_util.tree_leaves(bstats):
-            bstats = lax.pmean(bstats, axis_name)
+            bstats = lax.pmean(bstats, red_axes)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -246,7 +273,11 @@ def make_train_step(
         )
         return new_state, metrics, new_carry
 
-    batch_spec = P(None, axis_name)  # (nsteps, batch, ...)
+    if seq_axis is None:
+        batch_spec = P(None, axis_name)  # (nsteps, batch, ...)
+    else:
+        # (nsteps, batch, time): batch over data, time over seq
+        batch_spec = P(None, axis_name, seq_axis)
     if has_carry:
         fn = jax.shard_map(
             per_device,
@@ -286,6 +317,7 @@ def make_eval_step(
     meta: ModelMeta,
     mesh: Mesh,
     axis_name: str = DATA_AXIS,
+    seq_axis: Optional[str] = None,
 ) -> Callable:
     """Sharded eval step (reference `test`, dl_trainer.py:854-937).
 
@@ -297,7 +329,16 @@ def make_eval_step(
 
     classify -> {loss, top1, top5, count} sums; lm -> {loss, count};
     ctc -> {loss, count} (WER decoding is host-side, evaluate.py).
+
+    seq_axis: for seq-sharded lm models (ring attention), x/y shard their
+    time dim over it and sums psum over BOTH axes: each seq member holds
+    every sample's token slice with the same valid mask, so summed
+    per-shard token-mean losses and the P_seq-times-counted `count` divide
+    back to the true per-sample mean.
     """
+    red_axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    if seq_axis is not None and meta.has_carry:
+        raise ValueError("seq-sharded eval requires a carry-free lm model")
 
     def per_device(state: TrainState, batch, carry):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
@@ -323,17 +364,21 @@ def make_eval_step(
                 "top5": (top5 * valid).sum(),
                 "count": count,
             }
-            return lax.psum(sums, axis_name), carry
+            return lax.psum(sums, red_axes), carry
         if meta.task == "lm":
-            logits, new_carry = model.apply(
-                variables, batch["x"], carry=carry, train=False
-            )
+            if meta.has_carry:
+                logits, new_carry = model.apply(
+                    variables, batch["x"], carry=carry, train=False
+                )
+            else:
+                logits = model.apply(variables, batch["x"], train=False)
+                new_carry = carry
             per_tok = optax.softmax_cross_entropy_with_integer_labels(
                 logits, batch["y"]
             )  # (batch, time)
             per = per_tok.mean(axis=-1)  # per-sample mean token loss
             sums = {"loss": (per * valid).sum(), "count": count}
-            return lax.psum(sums, axis_name), new_carry
+            return lax.psum(sums, red_axes), new_carry
         if meta.task == "ctc":
             logits, out_lengths = model.apply(
                 variables, batch["x"], batch["input_lengths"], train=False
@@ -348,7 +393,7 @@ def make_eval_step(
             ).astype(jnp.float32)
             per = optax.ctc_loss(logits, logit_pad, batch["y"], label_pad)
             sums = {"loss": (per * valid).sum(), "count": count}
-            return lax.psum(sums, axis_name), carry
+            return lax.psum(sums, red_axes), carry
         raise ValueError(meta.task)
 
     if meta.has_carry:
@@ -365,11 +410,41 @@ def make_eval_step(
         m, _ = per_device(state, batch, None)
         return m
 
-    fn = jax.shard_map(
-        per_device_nocarry,
-        mesh=mesh,
-        in_specs=(P(), P(axis_name)),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return jax.jit(fn)
+    if seq_axis is None:
+        fn = jax.shard_map(
+            per_device_nocarry,
+            mesh=mesh,
+            in_specs=(P(), P(axis_name)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    # seq-sharded eval: per-key specs — rank-1 leaves (valid) shard the
+    # batch dim only, rank-2 token arrays shard (batch, time); built lazily
+    # per batch key-set since `valid` is optional
+    cache: dict = {}
+
+    def call(state, batch):
+        key = tuple(sorted(batch))
+        if key not in cache:
+            spec = {
+                k: (
+                    P(axis_name)
+                    if batch[k].ndim == 1
+                    else P(axis_name, seq_axis)
+                )
+                for k in batch
+            }
+            cache[key] = jax.jit(
+                jax.shard_map(
+                    per_device_nocarry,
+                    mesh=mesh,
+                    in_specs=(P(), spec),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )
+        return cache[key](state, batch)
+
+    return call

@@ -35,7 +35,7 @@ from mgwfbp_tpu.data import ShardInfo, data_prepare
 from mgwfbp_tpu.optim import make_optimizer
 from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
 from mgwfbp_tpu.parallel.costmodel import load_profile, lookup_alpha_beta
-from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
+from mgwfbp_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, MeshSpec, make_mesh
 from mgwfbp_tpu.profiling import benchmark_trainer_backward
 from mgwfbp_tpu.train.step import (
     create_train_state,
@@ -75,6 +75,43 @@ class Trainer:
         )
         self.process_batch = config.batch_size * local_data_devices
         self.model, self.meta = zoo.create_model(config.dnn, dataset=config.dataset)
+        if (
+            config.num_steps
+            and self.meta.task == "lm"
+            and not self.meta.has_carry
+        ):
+            # windowed-LM length override: retarget the model's position
+            # table and the meta the batches are built from
+            import dataclasses as _dc
+
+            self.meta = _dc.replace(
+                self.meta, input_shape=(config.num_steps,)
+            )
+            if hasattr(self.model, "max_len"):
+                self.model = self.model.clone(
+                    max_len=max(self.model.max_len, config.num_steps)
+                )
+        # sequence parallelism (ring attention): shard the lm time dim over
+        # the mesh's seq axis. Only carry-free lm models expose a seq_axis
+        # attribute (models/transformer.py). self.model stays axis-free
+        # (init / host-side apply run outside shard_map); the sharded steps
+        # get a seq-bound clone below.
+        self.seq_size = self.mesh.shape.get(SEQ_AXIS, 1)
+        self.seq_axis = None
+        if self.seq_size > 1:
+            if not hasattr(self.model, "seq_axis") or self.meta.has_carry:
+                raise ValueError(
+                    f"model {config.dnn!r} does not support sequence "
+                    "parallelism (needs a carry-free lm model with a "
+                    "seq_axis attribute, e.g. 'transformer')"
+                )
+            t = self.meta.input_shape[0]
+            if t % self.seq_size != 0:
+                raise ValueError(
+                    f"sequence length {t} not divisible by seq mesh extent "
+                    f"{self.seq_size}"
+                )
+            self.seq_axis = SEQ_AXIS
         image_hw = None
         if self.meta.task == "classify" and self.meta.input_shape[0] >= 256:
             image_hw = self.meta.input_shape[:2]  # inception 299
@@ -87,6 +124,7 @@ class Trainer:
             image_hw=image_hw,
             synthetic=synthetic_data,
             augment=config.augment,
+            num_steps=config.num_steps,
         )
         if self.bundle.num_classes != self.meta.num_classes:
             self.model, self.meta = zoo.create_model(
@@ -125,11 +163,18 @@ class Trainer:
                 config.policy,
                 self.reducer.schedule.predicted_nonoverlap_time,
             )
-        self.train_step = make_train_step(
-            self.model, self.meta, self.tx, self.mesh, self.reducer,
-            nsteps_update=config.nsteps_update,
+        step_model = (
+            self.model.clone(seq_axis=self.seq_axis)
+            if self.seq_axis
+            else self.model
         )
-        self.eval_step = make_eval_step(self.model, self.meta, self.mesh)
+        self.train_step = make_train_step(
+            step_model, self.meta, self.tx, self.mesh, self.reducer,
+            nsteps_update=config.nsteps_update, seq_axis=self.seq_axis,
+        )
+        self.eval_step = make_eval_step(
+            step_model, self.meta, self.mesh, seq_axis=self.seq_axis
+        )
         self.checkpointer = None
         if config.checkpoint_dir:
             # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
@@ -176,7 +221,13 @@ class Trainer:
             )
         return make_merged_allreduce(
             self.state.params,
-            axis_name=DATA_AXIS,
+            # with sequence parallelism every (data, seq) member computes a
+            # partial gradient; the merged buckets reduce over both axes
+            axis_name=(
+                DATA_AXIS
+                if self.seq_axis is None
+                else (DATA_AXIS, self.seq_axis)
+            ),
             policy=cfg.policy,
             tb=tb,
             cost_model=cost_model,
@@ -202,6 +253,13 @@ class Trainer:
         # by the local device count and under-merge the schedule
         per_device = max(self.config.batch_size, 1)
         batch = {k: v[:per_device] for k, v in batch.items()}
+        if self.seq_axis is not None:
+            # same inflation on the TIME dim: each seq member's backward
+            # covers T / seq_size tokens, so benchmark that slice
+            batch = {
+                k: (v[:, : v.shape[1] // self.seq_size] if v.ndim >= 2 else v)
+                for k, v in batch.items()
+            }
         paths = jax.tree_util.tree_flatten_with_path(self.state.params)[0]
         names = [jax.tree_util.keystr(kp) for kp, _ in paths]
         perm = arrival_order(len(names), names=names)
@@ -411,7 +469,10 @@ class Trainer:
                 sums[k] = sums.get(k, 0.0) + float(v)
         count = sums.pop("count", 0.0)
         out = {k: v / max(count, 1.0) for k, v in sums.items()}
-        out["count"] = count
+        # seq-sharded eval counts each sample once per sequence shard (the
+        # loss sums carry the same factor, so the means above are exact);
+        # report true samples-evaluated
+        out["count"] = count / self.seq_size
         if self.meta.task == "lm":
             # reference reports per-token perplexity (dl_trainer.py:927-929)
             out["perplexity"] = float(np.exp(out.get("loss", 0.0)))

@@ -57,6 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint directory to initialize weights from")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--seq-parallel", dest="seq_parallel", type=int, default=None)
+    p.add_argument("--num-steps", dest="num_steps", type=int, default=None,
+                   help="LM window length (must divide by --seq-parallel)")
     p.add_argument("--synthetic", action="store_true",
                    help="force synthetic data (no dataset files needed)")
     p.add_argument("--no-augment", action="store_true",
@@ -87,7 +89,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "nsteps_update", "policy", "threshold", "connection",
             "comm_profile", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
-            "compressor", "density",
+            "num_steps", "compressor", "density",
         )
         if getattr(args, k, None) is not None
     }

@@ -21,7 +21,7 @@ def _example_input(meta, batch=2):
 
 
 ALL_IMAGE_MODELS = [
-    n for n in zoo.model_names() if n not in ("lstm", "lstman4")
+    n for n in zoo.model_names() if n not in ("lstm", "lstman4", "transformer")
 ]
 
 
