@@ -84,7 +84,10 @@ def _is_oom(e: Exception) -> bool:
     return "resource_exhausted" in s or "out of memory" in s or "oom" in s
 
 
-def _bench_policy(policy, state0, model, meta, tx, mesh, batch_dict, tb, iters):
+def _bench_policy(
+    policy, state0, model, meta, tx, mesh, batch_dict, tb, iters,
+    compute_dtype=None,
+):
     """Build the step for one policy, warm up, time with per-iter host sync.
 
     Returns (sec_per_iter, merge_groups, flops_per_step)."""
@@ -106,7 +109,10 @@ def _bench_policy(policy, state0, model, meta, tx, mesh, batch_dict, tb, iters):
             tb=tb if policy == "mgwfbp" else None,
             cost_model=lookup_alpha_beta("ici", max(n_dev, 2)),
         )
-    step = make_train_step(model, meta, tx, mesh, reducer, donate=False)
+    step = make_train_step(
+        model, meta, tx, mesh, reducer, compute_dtype=compute_dtype,
+        donate=False,
+    )
 
     flops = None
     try:
@@ -158,6 +164,14 @@ def run_bench() -> dict:
     preset_bs = PRESETS.get(model_name, {}).get("batch_size", 32)
     batch = int(os.environ.get("MGWFBP_BENCH_BATCH", str(preset_bs)))
     iters = int(os.environ.get("MGWFBP_BENCH_ITERS", "50"))
+    # bf16 compute is the native TPU path (master weights stay fp32, the
+    # reference's apex-O2 analogue); MGWFBP_BENCH_DTYPE=float32 opts out
+    dtype_name = os.environ.get("MGWFBP_BENCH_DTYPE", "bfloat16")
+    import jax.numpy as _jnp
+
+    compute_dtype = (
+        None if dtype_name in ("float32", "f32") else _jnp.dtype(dtype_name)
+    )
 
     devices = _devices_with_retry()
     n_dev = len(devices)
@@ -192,12 +206,13 @@ def run_bench() -> dict:
         # invented — VERDICT r2 Weak #4); trace-attributed when possible
         tb_prof = benchmark_trainer_backward(
             model, meta, state.params, state.batch_stats, micro, perm,
-            warmup=2, iters=5, names=names,
+            warmup=2, iters=5, names=names, compute_dtype=compute_dtype,
         )
         grid: dict[str, dict] = {}
         for policy in _POLICIES:
             dt, groups, flops = _bench_policy(
-                policy, state, model, meta, tx, mesh, bd, tb_prof, iters
+                policy, state, model, meta, tx, mesh, bd, tb_prof, iters,
+                compute_dtype=compute_dtype,
             )
             grid[policy] = {
                 "sec_per_iter": round(dt, 6),
@@ -237,6 +252,7 @@ def run_bench() -> dict:
         "device_kind": devices[0].device_kind,
         "batch_per_device": batch,
         "batch_fallback": batch_fallback,
+        "compute_dtype": dtype_name,
         "iters": iters,
         "sec_per_iter": dt,
         "merge_groups": main["merge_groups"],
@@ -250,6 +266,15 @@ def run_bench() -> dict:
         payload["mfu"] = round(mfu, 4)
     if flops is not None:
         payload["flops_per_step"] = flops
+    if n_dev == 1:
+        payload["note"] = (
+            "single chip: collectives are no-ops, so the XLA-fused oracle "
+            "('none'/'single') is the ceiling and merge scheduling can only "
+            "add dispatch overhead; MG-WFBP's advantage needs real "
+            "inter-chip communication (compare policies on a multi-chip "
+            "mesh). The production Trainer skips the reducer entirely at "
+            "world size 1 (reference single-path parity)."
+        )
     if mfu is not None and mfu > 1.0:
         # physically impossible: the measurement layer is broken; refuse to
         # report a throughput number (VERDICT r2 Weak #2)

@@ -30,6 +30,7 @@ from mgwfbp_tpu.parallel.solver import (
     MergeSchedule,
     build_schedule,
     check_unique,
+    predict_group_times,
     simulate_groups,
 )
 
@@ -249,14 +250,18 @@ def make_merged_allreduce(
         # predictions on the groups actually issued.
         schedule = dataclasses.replace(schedule, groups=layout.groups)
         if tb is not None and cost_model is not None:
+            sizes_b = [s.nbytes for s in specs]
             total, nonoverlap, comm = simulate_groups(
-                layout.groups, [s.nbytes for s in specs], tb, cost_model.predict
+                layout.groups, sizes_b, tb, cost_model.predict
             )
             schedule = dataclasses.replace(
                 schedule,
                 predicted_total_time=total,
                 predicted_nonoverlap_time=nonoverlap,
                 predicted_comm_time=comm,
+                predicted_group_times=predict_group_times(
+                    layout.groups, sizes_b, cost_model.predict
+                ),
             )
     return MergedAllreduce(
         schedule=schedule,

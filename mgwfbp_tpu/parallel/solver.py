@@ -65,6 +65,11 @@ class MergeSchedule:
     predicted_total_time: float  # ready-to-step wall clock, seconds
     predicted_nonoverlap_time: float  # comm time not hidden by backward
     predicted_comm_time: float  # sum of per-group collective durations
+    # per-group (payload_bytes, predicted_seconds), arrival order — the
+    # reference logs this prediction and measures each merged tensor's
+    # allreduce in-loop (distributed_optimizer.py:256-259, 374-391);
+    # tools/overlap_report.py compares these against trace timings
+    predicted_group_times: tuple[tuple[int, float], ...] = ()
 
     @property
     def num_groups(self) -> int:
@@ -72,6 +77,19 @@ class MergeSchedule:
 
     def named_groups(self) -> list[list[str]]:
         return [[self.layer_names[i] for i in g] for g in self.groups]
+
+
+def predict_group_times(
+    groups: Sequence[Sequence[int]],
+    sizes_bytes: Sequence[int],
+    cost: CostFn,
+) -> tuple[tuple[int, float], ...]:
+    """Per-group (payload_bytes, predicted_seconds), arrival order."""
+    out = []
+    for g in groups:
+        b = int(sum(sizes_bytes[i] for i in g))
+        out.append((b, float(cost(b))))
+    return tuple(out)
 
 
 def simulate_groups(
@@ -239,14 +257,17 @@ def build_schedule(
 
     if tb is not None and cost_model is not None and len(layers):
         total, nonoverlap, comm = simulate_groups(groups, nbytes, tb, cost_model.predict)
+        group_times = predict_group_times(groups, nbytes, cost_model.predict)
     else:
         total = nonoverlap = comm = float("nan")
+        group_times = ()
     return MergeSchedule(
         groups=tuple(tuple(g) for g in groups),
         layer_names=names,
         predicted_total_time=total,
         predicted_nonoverlap_time=nonoverlap,
         predicted_comm_time=comm,
+        predicted_group_times=group_times,
     )
 
 

@@ -272,6 +272,7 @@ def benchmark_trainer_backward(
     warmup: int = 5,
     iters: int = 50,
     names: Optional[Sequence[str]] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> list[float]:
     """benchmark(trainer) parity (reference profiling.py:95-147): measure
     the model's backward on one device and return arrival-ordered tb.
@@ -282,7 +283,7 @@ def benchmark_trainer_backward(
     nothing, the measured TOTAL is distributed by the volume prior."""
     from mgwfbp_tpu.train.step import make_loss_fn
 
-    loss_fn = make_loss_fn(model, meta)
+    loss_fn = make_loss_fn(model, meta, compute_dtype=compute_dtype)
     rng = jax.random.PRNGKey(0)
     carry = None
     if getattr(meta, "has_carry", False):

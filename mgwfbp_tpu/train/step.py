@@ -77,22 +77,76 @@ def create_train_state(
     )
 
 
+def _cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast floating leaves of a pytree to `dtype`; others untouched."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def make_loss_fn(model: Any, meta: ModelMeta, aux_weight: float = 0.3) -> Callable:
+def make_loss_fn(
+    model: Any,
+    meta: ModelMeta,
+    aux_weight: float = 0.3,
+    compute_dtype: Optional[Any] = None,
+) -> Callable:
     """loss_fn(params, batch_stats, batch, rng, carry) ->
     (loss, (new_batch_stats, new_carry, metrics)).
 
     Handles the reference's model-specific forward/loss paths
     (dl_trainer.py:802-818): aux-logits CNNs (googlenet/inceptionv3 0.3 aux
     weight), LM with carried hidden state, CTC for speech.
+
+    compute_dtype (e.g. jnp.bfloat16): mixed-precision policy — MASTER
+    params/batch_stats/carry stay float32 (the optimizer state and update
+    math too), but the forward/backward runs at the cast dtype so matmuls
+    and convs hit the MXU at native bf16 rate. Logits are cast back to
+    float32 before any softmax/CTC, losses/metrics are float32, and state
+    coming out of the model (batch_stats, carry) is cast back to the master
+    dtype so carries stay shape/dtype-stable across steps. This is the TPU
+    answer to the reference's apex AMP O2 path (dl_trainer.py:274-281,
+    settings.FP16) — bf16 needs no loss scaling.
     """
 
     def loss_fn(params, batch_stats, batch, rng, carry):
+        master_bstats = batch_stats
+        if compute_dtype is not None:
+            params = _cast_floating(params, compute_dtype)
+            batch_stats = _cast_floating(batch_stats, compute_dtype)
+            batch = _cast_floating(batch, compute_dtype)
+            carry = _cast_floating(carry, compute_dtype)
         variables = {"params": params, "batch_stats": batch_stats}
         rngs = {"dropout": rng}
+
+        def restate(updates_bstats, new_carry):
+            """Model-state outputs back at the master dtype.
+
+            batch_stats are EMA ACCUMULATORS: the update the model computed
+            used a bf16-quantized copy of the master, and feeding its result
+            straight back would bake that quantization in every step (a
+            momentum-amplified ~1% steady-state bias, measured). Instead,
+            merge the DELTA into the f32 master:
+                master' = master + (new - quantize(master))
+            which keeps accumulation at f32 precision while the forward
+            stays fully bf16. Carries are plain values, a cast suffices.
+            """
+            if compute_dtype is None:
+                return updates_bstats, new_carry
+            def merge(master, new):
+                q = master.astype(compute_dtype).astype(master.dtype)
+                return master + (new.astype(master.dtype) - q)
+            merged = jax.tree_util.tree_map(
+                merge, master_bstats, updates_bstats
+            )
+            return merged, _cast_floating(new_carry, jnp.float32)
+
         if meta.task == "classify":
             out, updates = model.apply(
                 variables, batch["x"], train=True,
@@ -100,15 +154,21 @@ def make_loss_fn(model: Any, meta: ModelMeta, aux_weight: float = 0.3) -> Callab
             )
             if meta.has_aux_logits:
                 logits, *aux = out
+                logits = logits.astype(jnp.float32)
                 loss = cross_entropy(logits, batch["y"])
                 for a in aux:
-                    loss = loss + aux_weight * cross_entropy(a, batch["y"])
+                    loss = loss + aux_weight * cross_entropy(
+                        a.astype(jnp.float32), batch["y"]
+                    )
             else:
-                logits = out
+                logits = out.astype(jnp.float32)
                 loss = cross_entropy(logits, batch["y"])
             correct = (jnp.argmax(logits, -1) == batch["y"]).mean()
             metrics = {"loss": loss, "accuracy": correct}
-            return loss, (updates.get("batch_stats", batch_stats), carry, metrics)
+            bstats_out, carry_out = restate(
+                updates.get("batch_stats", master_bstats), carry
+            )
+            return loss, (bstats_out, carry_out, metrics)
         if meta.task == "lm":
             if meta.has_carry:
                 (logits, new_carry), updates = model.apply(
@@ -121,11 +181,15 @@ def make_loss_fn(model: Any, meta: ModelMeta, aux_weight: float = 0.3) -> Callab
                     mutable=["batch_stats"], rngs=rngs,
                 )
                 new_carry = carry
+            logits = logits.astype(jnp.float32)
             loss = cross_entropy(
                 logits.reshape(-1, logits.shape[-1]), batch["y"].reshape(-1)
             )
             metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
-            return loss, (updates.get("batch_stats", batch_stats), new_carry, metrics)
+            bstats_out, carry_out = restate(
+                updates.get("batch_stats", master_bstats), new_carry
+            )
+            return loss, (bstats_out, carry_out, metrics)
         if meta.task == "ctc":
             (logits, out_lengths), updates = model.apply(
                 variables, batch["x"], batch["input_lengths"], train=True,
@@ -139,10 +203,15 @@ def make_loss_fn(model: Any, meta: ModelMeta, aux_weight: float = 0.3) -> Callab
                 jnp.arange(batch["y"].shape[1])[None, :]
                 >= batch["label_lengths"][:, None]
             ).astype(jnp.float32)
-            per_seq = optax.ctc_loss(logits, logit_pad, batch["y"], label_pad)
+            per_seq = optax.ctc_loss(
+                logits.astype(jnp.float32), logit_pad, batch["y"], label_pad
+            )
             loss = per_seq.mean()
             metrics = {"loss": loss}
-            return loss, (updates.get("batch_stats", batch_stats), carry, metrics)
+            bstats_out, carry_out = restate(
+                updates.get("batch_stats", master_bstats), carry
+            )
+            return loss, (bstats_out, carry_out, metrics)
         raise ValueError(f"unknown task {meta.task!r}")
 
     return loss_fn
@@ -158,9 +227,14 @@ def make_train_step(
     nsteps_update: int = 1,
     axis_name: str = DATA_AXIS,
     seq_axis: Optional[str] = None,
+    compute_dtype: Optional[Any] = None,
     donate: bool = True,
 ) -> Callable:
     """Build the jitted sharded train step.
+
+    compute_dtype: mixed-precision forward/backward dtype (see
+    make_loss_fn) — master params, optimizer math, and collectives stay
+    float32 unless comm_dtype narrows the wire separately.
 
     reducer: the MG-WFBP merged all-reduce (None -> one flat pmean, i.e. the
     reference's single-group / SyncEASGD limit is reducer with policy
@@ -180,7 +254,7 @@ def make_train_step(
       lm without carry (transformer): step(state, batch) -> (state, metrics)
     Batch leaves are (nsteps_update, global_batch, ...); sharded on dim 1.
     """
-    loss_fn = make_loss_fn(model, meta)
+    loss_fn = make_loss_fn(model, meta, compute_dtype=compute_dtype)
     has_carry = meta.has_carry
     if seq_axis is not None and has_carry:
         raise ValueError(
@@ -318,6 +392,7 @@ def make_eval_step(
     mesh: Mesh,
     axis_name: str = DATA_AXIS,
     seq_axis: Optional[str] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> Callable:
     """Sharded eval step (reference `test`, dl_trainer.py:854-937).
 
@@ -340,17 +415,25 @@ def make_eval_step(
     if seq_axis is not None and meta.has_carry:
         raise ValueError("seq-sharded eval requires a carry-free lm model")
 
+    def _c(tree):
+        if compute_dtype is None:
+            return tree
+        return _cast_floating(tree, compute_dtype)
+
     def per_device(state: TrainState, batch, carry):
-        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        variables = _c(
+            {"params": state.params, "batch_stats": state.batch_stats}
+        )
         if "valid" in batch:
             valid = batch["valid"]  # (local_batch,) float, 1.0 = real sample
         else:  # unpadded batch: every sample counts
             valid = jnp.ones((batch["x"].shape[0],), jnp.float32)
         count = valid.sum()
         if meta.task == "classify":
-            logits = model.apply(variables, batch["x"], train=False)
+            logits = model.apply(variables, _c(batch["x"]), train=False)
             if isinstance(logits, (tuple, list)):
                 logits = logits[0]
+            logits = logits.astype(jnp.float32)
             per = optax.softmax_cross_entropy_with_integer_labels(
                 logits, batch["y"]
             )
@@ -368,11 +451,15 @@ def make_eval_step(
         if meta.task == "lm":
             if meta.has_carry:
                 logits, new_carry = model.apply(
-                    variables, batch["x"], carry=carry, train=False
+                    variables, batch["x"], carry=_c(carry), train=False
+                )
+                new_carry = jax.tree_util.tree_map(
+                    lambda a, ref: a.astype(ref.dtype), new_carry, carry
                 )
             else:
                 logits = model.apply(variables, batch["x"], train=False)
                 new_carry = carry
+            logits = logits.astype(jnp.float32)
             per_tok = optax.softmax_cross_entropy_with_integer_labels(
                 logits, batch["y"]
             )  # (batch, time)
@@ -381,8 +468,9 @@ def make_eval_step(
             return lax.psum(sums, red_axes), new_carry
         if meta.task == "ctc":
             logits, out_lengths = model.apply(
-                variables, batch["x"], batch["input_lengths"], train=False
+                variables, _c(batch["x"]), batch["input_lengths"], train=False
             )
+            logits = logits.astype(jnp.float32)
             t = logits.shape[1]
             logit_pad = (
                 jnp.arange(t)[None, :] >= out_lengths[:, None]

@@ -74,23 +74,15 @@ class Trainer:
             self.data_size // jax.process_count(), 1
         )
         self.process_batch = config.batch_size * local_data_devices
+        # mixed-precision compute policy (config.dtype; the reference's
+        # apex FP16 O2 analogue — bf16 on TPU, no loss scaling)
+        self.compute_dtype = (
+            jnp.dtype(config.dtype)
+            if config.dtype not in (None, "", "float32", "f32")
+            else None
+        )
         self.model, self.meta = zoo.create_model(config.dnn, dataset=config.dataset)
-        if (
-            config.num_steps
-            and self.meta.task == "lm"
-            and not self.meta.has_carry
-        ):
-            # windowed-LM length override: retarget the model's position
-            # table and the meta the batches are built from
-            import dataclasses as _dc
-
-            self.meta = _dc.replace(
-                self.meta, input_shape=(config.num_steps,)
-            )
-            if hasattr(self.model, "max_len"):
-                self.model = self.model.clone(
-                    max_len=max(self.model.max_len, config.num_steps)
-                )
+        self._apply_lm_window()
         # sequence parallelism (ring attention): shard the lm time dim over
         # the mesh's seq axis. Only carry-free lm models expose a seq_axis
         # attribute (models/transformer.py). self.model stays axis-free
@@ -131,6 +123,9 @@ class Trainer:
                 config.dnn, dataset=config.dataset,
                 num_classes=self.bundle.num_classes,
             )
+            # the rebuild reset meta/model to registry defaults; re-apply
+            # the window-length override
+            self._apply_lm_window()
         self.tx, self.epoch_schedule = make_optimizer(
             config.lr,
             momentum=config.momentum,
@@ -171,9 +166,11 @@ class Trainer:
         self.train_step = make_train_step(
             step_model, self.meta, self.tx, self.mesh, self.reducer,
             nsteps_update=config.nsteps_update, seq_axis=self.seq_axis,
+            compute_dtype=self.compute_dtype,
         )
         self.eval_step = make_eval_step(
-            step_model, self.meta, self.mesh, seq_axis=self.seq_axis
+            step_model, self.meta, self.mesh, seq_axis=self.seq_axis,
+            compute_dtype=self.compute_dtype,
         )
         self.checkpointer = None
         if config.checkpoint_dir:
@@ -188,6 +185,24 @@ class Trainer:
         self._maybe_resume()
 
     # ------------------------------------------------------------------
+    def _apply_lm_window(self) -> None:
+        """Windowed-LM length override (--num-steps): retarget the model's
+        position table and the meta the batches are built from."""
+        config = self.config
+        if not (
+            config.num_steps
+            and self.meta.task == "lm"
+            and not self.meta.has_carry
+        ):
+            return
+        import dataclasses as _dc
+
+        self.meta = _dc.replace(self.meta, input_shape=(config.num_steps,))
+        if hasattr(self.model, "max_len"):
+            self.model = self.model.clone(
+                max_len=max(self.model.max_len, config.num_steps)
+            )
+
     def _example_input(self) -> Any:
         meta = self.meta
         shape = (1,) + tuple(meta.input_shape)
@@ -200,6 +215,16 @@ class Trainer:
         if cfg.policy in ("none", "xla"):
             # the ORIGINAL_HOROVOD-style oracle: one pmean per grad leaf
             # fused at XLA's discretion (reference settings.py:34 A/B switch)
+            return None
+        if self.data_size * self.seq_size == 1:
+            # single device: no communication exists to schedule — the
+            # reference's single-process path runs WITHOUT the distributed
+            # optimizer (dl_trainer.py train_with_single, :956-984); a
+            # merge schedule here would only add no-op collective dispatch
+            self.log.info(
+                "single device: skipping merged-allreduce scheduling "
+                "(policy %s inert, reference single-path parity)", cfg.policy,
+            )
             return None
         if cfg.comm_profile:
             cost_model = load_profile(cfg.comm_profile)
@@ -267,6 +292,7 @@ class Trainer:
         tb = benchmark_trainer_backward(
             self.model, self.meta, self.state.params, self.state.batch_stats,
             batch, perm, warmup=2, iters=10, names=names,
+            compute_dtype=self.compute_dtype,
         )
         self._persist_tb(tb, names, perm)
         if jax.process_count() > 1:

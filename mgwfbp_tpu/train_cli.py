@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cost-model link class: ici|dcn|56GbIB|10GbE")
     p.add_argument("--comm-profile", dest="comm_profile", default=None,
                    help="path to calibrated alpha-beta json (see calibrate)")
+    p.add_argument("--dtype", default=None,
+                   help="compute dtype: float32 | bfloat16 (mixed precision;"
+                        " master weights stay float32)")
     p.add_argument("--comm-dtype", dest="comm_dtype", default=None,
                    help="wire dtype for collectives, e.g. bfloat16")
     p.add_argument("--norm-clip", dest="norm_clip", type=float, default=None)
@@ -87,7 +90,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         for k in (
             "dataset", "data_dir", "batch_size", "lr", "max_epochs",
             "nsteps_update", "policy", "threshold", "connection",
-            "comm_profile", "comm_dtype", "norm_clip", "lr_schedule",
+            "comm_profile", "dtype", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
             "num_steps", "compressor", "density",
         )

@@ -267,6 +267,54 @@ def capture_and_report(
             "trace_dir": logdir,
         }
     )
+    if reducer is not None and reducer.schedule.predicted_group_times:
+        # predicted-vs-actual per merged collective (reference logs the
+        # prediction and times each merged tensor's allreduce in-loop,
+        # distributed_optimizer.py:256-259, 374-391, 407-425). Alignment is
+        # by rank order of duration/size: the trace does not carry group
+        # identity, but the k-th largest collective should correspond to
+        # the k-th largest bucket.
+        pred = sorted(
+            (
+                {"bytes": b, "predicted_s": t}
+                for b, t in reducer.schedule.predicted_group_times
+            ),
+            key=lambda r: -r["bytes"],
+        )
+        # aggregate the per-step events of each collective op (same HLO
+        # instruction name recurs once per timed step) into a mean duration
+        by_name: dict = {}
+        for ev in out.get("collectives", []):
+            agg = by_name.setdefault(ev["name"], {"total": 0.0, "n": 0})
+            agg["total"] += ev["dur_us"]
+            agg["n"] += 1
+        actual = sorted(
+            (
+                {"name": k, "mean_us": v["total"] / v["n"]}
+                for k, v in by_name.items()
+            ),
+            key=lambda r: -r["mean_us"],
+        )[: len(pred)]
+        rows = []
+        for i, p in enumerate(pred):
+            row = dict(p)
+            if i < len(actual):
+                meas = actual[i]["mean_us"] / 1e6
+                row["measured_s"] = round(meas, 9)
+                row["measured_over_predicted"] = (
+                    round(meas / p["predicted_s"], 3)
+                    if p["predicted_s"] > 0
+                    else None
+                )
+            rows.append(row)
+        out["predicted_vs_actual"] = rows
+        out["alignment_caveat"] = (
+            "rank-order alignment by duration: the trace's collective list "
+            "also contains the metrics and batch_stats pmeans, so rows near "
+            "the small-bucket tail may pair a bucket prediction with one of "
+            "those; trust the large-bucket rows, and cross-check counts "
+            "against merge_groups (+2 for metrics/bstats on BN models)"
+        )
     return out
 
 
