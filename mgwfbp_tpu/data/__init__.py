@@ -113,7 +113,13 @@ def data_prepare(
         # 381-385: RandomCrop+flip for CIFAR, RandomResizedCrop+flip for
         # ImageNet; eval uses only normalize)
         train_tf = normalize
-        if augment:
+        if augment and name == "cifar10":
+            # fused crop+flip+normalize: one pass over the uint8 batch via
+            # the native C++ kernel (NumPy fallback is bit-identical)
+            from mgwfbp_tpu.data.augment import FusedCropFlipNormalize
+
+            train_tf = FusedCropFlipNormalize(mean, std, pad=4)
+        elif augment:
             from mgwfbp_tpu.data.augment import chain, train_augment
 
             aug = train_augment(name)

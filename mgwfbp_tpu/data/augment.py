@@ -30,21 +30,30 @@ def random_hflip(x: np.ndarray, rng: np.random.Generator, p: float = 0.5) -> np.
     return out
 
 
+def crop_at_offsets(
+    x: np.ndarray, ys: np.ndarray, xs: np.ndarray, pad: int
+) -> np.ndarray:
+    """Zero-pad by `pad`, crop back to the original size at the given
+    per-sample offsets (0..2*pad)."""
+    b, h, w, c = x.shape
+    padded = np.pad(
+        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+    )
+    out = np.empty_like(x)
+    for i in range(b):
+        out[i] = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
+    return out
+
+
 def random_crop(
     x: np.ndarray, rng: np.random.Generator, pad: int = 4
 ) -> np.ndarray:
     """Zero-pad by `pad` on each spatial side, crop back to the original
     size at a per-sample random offset (torchvision RandomCrop(size, pad))."""
-    b, h, w, c = x.shape
-    padded = np.pad(
-        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
-    )
+    b = x.shape[0]
     ys = rng.integers(0, 2 * pad + 1, size=b)
     xs = rng.integers(0, 2 * pad + 1, size=b)
-    out = np.empty_like(x)
-    for i in range(b):
-        out[i] = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
-    return out
+    return crop_at_offsets(x, ys, xs, pad)
 
 
 def random_resized_crop(
@@ -104,6 +113,47 @@ def random_resized_crop(
     top_row = f[bi, y0e, x0e] * (1 - wx) + f[bi, y0e, x1e] * wx
     bot_row = f[bi, y1e, x0e] * (1 - wx) + f[bi, y1e, x1e] * wx
     return top_row * (1 - wy) + bot_row * wy
+
+
+class FusedCropFlipNormalize:
+    """CIFAR-style crop + flip + normalize as ONE pass over the batch.
+
+    Uses the native C++ kernel (mgwfbp_tpu.native) when available — a single
+    read of the uint8 batch producing normalized float32 — with a
+    bit-identical NumPy fallback (randomness is drawn host-side with the
+    same call order either way, so native and fallback produce the same
+    bytes for the same seed)."""
+
+    wants_rng = True
+
+    def __init__(self, mean, std, pad: int = 4, p_flip: float = 0.5):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.pad = pad
+        self.p_flip = p_flip
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        b = x.shape[0]
+        ys = rng.integers(0, 2 * self.pad + 1, size=b)
+        xs = rng.integers(0, 2 * self.pad + 1, size=b)
+        flips = rng.random(b) < self.p_flip
+        if x.dtype == np.uint8:
+            from mgwfbp_tpu import native
+
+            out = native.fused_crop_flip_normalize(
+                x, ys, xs, flips.astype(np.uint8), self.mean, self.std,
+                self.pad,
+            )
+            if out is not None:
+                return out
+        # fallback: crop_at_offsets returns a fresh array, flip in place;
+        # use the SAME affine factorization (px*scale - shift) as the C++
+        # kernel so both paths round identically in float32
+        x = crop_at_offsets(x, ys, xs, self.pad)
+        x[flips] = x[flips, :, ::-1]
+        scale = (1.0 / (255.0 * self.std)).astype(np.float32)
+        shift = (self.mean / self.std).astype(np.float32)
+        return x.astype(np.float32) * scale - shift
 
 
 class Augment:

@@ -140,12 +140,23 @@ def normalize_images(
     mean: tuple[float, ...], std: tuple[float, ...]
 ) -> Callable[[np.ndarray], np.ndarray]:
     """uint8 HWC images -> normalized float32 (the reference's torchvision
-    transforms.Normalize equivalents, dl_trainer.py:369-409)."""
+    transforms.Normalize equivalents, dl_trainer.py:369-409).
+
+    uint8 batches go through the fused native kernel when available
+    (mgwfbp_tpu.native.normalize_u8); the NumPy fallback uses the same
+    px*scale - shift affine so both round identically in float32."""
     mean_a = np.asarray(mean, dtype=np.float32)
     std_a = np.asarray(std, dtype=np.float32)
+    scale = (1.0 / (255.0 * std_a)).astype(np.float32)
+    shift = (mean_a / std_a).astype(np.float32)
 
     def _t(x: np.ndarray) -> np.ndarray:
-        x = x.astype(np.float32) / 255.0
-        return (x - mean_a) / std_a
+        if x.dtype == np.uint8 and x.ndim >= 1:
+            from mgwfbp_tpu import native
+
+            out = native.normalize_u8(x, mean_a, std_a)
+            if out is not None:
+                return out
+        return x.astype(np.float32) * scale - shift
 
     return _t

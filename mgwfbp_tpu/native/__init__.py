@@ -1,0 +1,140 @@
+"""Native (C++) host-side data-path kernels with lazy build + ctypes binding.
+
+The compute path of this framework is JAX/XLA on TPU; the runtime AROUND it
+— here, the loader's augmentation/normalization hot loop — is native C++
+(SURVEY.md §2.9: the reference's data path rides torch DataLoader's C
+workers). The extension is built on first use with the container's g++
+(no pip; pybind11 unavailable by design — plain C ABI + ctypes), cached
+next to the source, and every caller has a bit-identical NumPy fallback:
+`available()` returning False never blocks training.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "augment.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_DIR, f"libmgwfbp_native_{tag}.so")
+
+
+def _build(so: str) -> bool:
+    import tempfile
+
+    # per-process temp output: concurrent first-use builds (e.g. two ranks
+    # of a multi-process run on one box) must not interleave writes into a
+    # shared .tmp before the atomic publish
+    fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call; None when no
+    toolchain is available (callers fall back to NumPy)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        so = _so_path()
+        if not os.path.exists(so) and not _build(so):
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        i64 = ctypes.c_int64
+        lib.fused_crop_flip_normalize.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            i64, i64, i64, i64, i64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.fused_crop_flip_normalize.restype = None
+        lib.normalize_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64, i64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.normalize_u8.restype = None
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def fused_crop_flip_normalize(
+    x: np.ndarray,
+    oy: np.ndarray,
+    ox: np.ndarray,
+    flip: np.ndarray,
+    mean: np.ndarray,
+    std: np.ndarray,
+    pad: int,
+) -> Optional[np.ndarray]:
+    """One-pass crop+flip+normalize of a uint8 (B,H,W,C) batch; None when
+    the native library is unavailable or inputs don't qualify."""
+    lib = get_lib()
+    if lib is None or x.dtype != np.uint8 or x.ndim != 4 or x.shape[3] > 16:
+        return None
+    x = np.ascontiguousarray(x)
+    b, h, w, c = x.shape
+    out = np.empty((b, h, w, c), np.float32)
+    oy = np.ascontiguousarray(oy, np.int64)
+    ox = np.ascontiguousarray(ox, np.int64)
+    fl = np.ascontiguousarray(flip, np.uint8)
+    m = np.ascontiguousarray(mean, np.float32)
+    s = np.ascontiguousarray(std, np.float32)
+    lib.fused_crop_flip_normalize(
+        x.ctypes.data, out.ctypes.data, b, h, w, c, pad,
+        oy.ctypes.data, ox.ctypes.data, fl.ctypes.data,
+        m.ctypes.data, s.ctypes.data,
+    )
+    return out
+
+
+def normalize_u8(
+    x: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> Optional[np.ndarray]:
+    """Fused uint8 -> normalized float32; None when unavailable."""
+    lib = get_lib()
+    if lib is None or x.dtype != np.uint8 or x.shape[-1] > 16:
+        return None
+    x = np.ascontiguousarray(x)
+    out = np.empty(x.shape, np.float32)
+    m = np.ascontiguousarray(mean, np.float32)
+    s = np.ascontiguousarray(std, np.float32)
+    lib.normalize_u8(
+        x.ctypes.data, out.ctypes.data, x.size, x.shape[-1],
+        m.ctypes.data, s.ctypes.data,
+    )
+    return out

@@ -1,0 +1,84 @@
+// Native data-path kernels: fused crop + flip + normalize for the host-side
+// loader (mgwfbp_tpu/data). The reference leans on torchvision's C/libjpeg
+// transforms inside torch DataLoader workers (SURVEY.md §2.8); this is the
+// framework's own native equivalent: one pass over the uint8 batch producing
+// normalized float32, instead of numpy's pad -> crop -> flip -> cast ->
+// normalize chain (each a full-batch memory round trip).
+//
+// Randomness stays in Python (offsets/flips are drawn with the same seeded
+// generator as the NumPy fallback), so both paths are bit-identical and the
+// fallback is always available — no build step required to train.
+//
+// Build (done lazily by native/build.py):
+//   g++ -O3 -shared -fPIC -o libmgwfbp_native.so augment.cpp
+
+#include <cstdint>
+
+extern "C" {
+
+// x: (B, H, W, C) uint8. out: (B, H, W, C) float32.
+// oy/ox: (B,) crop offsets into the zero-padded image (0..2*pad).
+// flip: (B,) 0/1 horizontal flip AFTER the crop.
+// mean/std: (C,) normalization in 0..1 scale: out = (x/255 - mean) / std.
+void fused_crop_flip_normalize(
+    const uint8_t* x, float* out,
+    int64_t b, int64_t h, int64_t w, int64_t c,
+    int64_t pad,
+    const int64_t* oy, const int64_t* ox, const uint8_t* flip,
+    const float* mean, const float* stddev) {
+  // precompute per-channel affine: out = px * (1/(255*std)) - mean/std
+  float scale[16];
+  float shift[16];
+  for (int64_t k = 0; k < c && k < 16; ++k) {
+    scale[k] = 1.0f / (255.0f * stddev[k]);
+    shift[k] = mean[k] / stddev[k];
+  }
+  for (int64_t i = 0; i < b; ++i) {
+    const uint8_t* img = x + i * h * w * c;
+    float* dst = out + i * h * w * c;
+    const int64_t top = oy[i] - pad;   // source row of output row 0
+    const int64_t left = ox[i] - pad;  // source col of output col 0
+    const bool fl = flip[i] != 0;
+    for (int64_t y = 0; y < h; ++y) {
+      const int64_t sy = y + top;
+      float* row = dst + y * w * c;
+      if (sy < 0 || sy >= h) {  // fully padded row -> normalized zeros
+        for (int64_t xcol = 0; xcol < w; ++xcol)
+          for (int64_t k = 0; k < c; ++k) row[xcol * c + k] = -shift[k];
+        continue;
+      }
+      const uint8_t* srow = img + sy * w * c;
+      for (int64_t xcol = 0; xcol < w; ++xcol) {
+        // output col xcol reads crop col (flipped or not)
+        const int64_t cc = fl ? (w - 1 - xcol) : xcol;
+        const int64_t sx = cc + left;
+        float* px = row + xcol * c;
+        if (sx < 0 || sx >= w) {
+          for (int64_t k = 0; k < c; ++k) px[k] = -shift[k];
+        } else {
+          const uint8_t* sp = srow + sx * c;
+          for (int64_t k = 0; k < c; ++k)
+            px[k] = (float)sp[k] * scale[k] - shift[k];
+        }
+      }
+    }
+  }
+}
+
+// Plain fused uint8 -> normalized float32 (eval path / no augmentation).
+void normalize_u8(
+    const uint8_t* x, float* out, int64_t n, int64_t c,
+    const float* mean, const float* stddev) {
+  float scale[16];
+  float shift[16];
+  for (int64_t k = 0; k < c && k < 16; ++k) {
+    scale[k] = 1.0f / (255.0f * stddev[k]);
+    shift[k] = mean[k] / stddev[k];
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t k = i % c;
+    out[i] = (float)x[i] * scale[k] - shift[k];
+  }
+}
+
+}  // extern "C"

@@ -135,10 +135,10 @@ class Trainer:
             max_epochs=config.max_epochs,
             warmup_epochs=config.warmup_epochs,
             # the optimizer step counter ticks once per nsteps_update
-            # micro-batches, so convert loader batches -> optimizer steps
+            # micro-batches, so convert loader batches -> optimizer steps;
+            # config.num_batches_per_epoch caps the epoch (smoke runs)
             num_batches_per_epoch=max(
-                self.bundle.num_batches_per_epoch // max(config.nsteps_update, 1),
-                1,
+                self._steps_per_epoch(), 1,
             ),
             norm_clip=config.norm_clip,
         )
@@ -185,6 +185,16 @@ class Trainer:
         self._maybe_resume()
 
     # ------------------------------------------------------------------
+    def _steps_per_epoch(self) -> int:
+        """Optimizer steps per epoch: loader batches / nsteps_update, capped
+        by config.num_batches_per_epoch when set (smoke/CI runs)."""
+        steps = self.bundle.num_batches_per_epoch // max(
+            self.config.nsteps_update, 1
+        )
+        if self.config.num_batches_per_epoch:
+            steps = min(steps, self.config.num_batches_per_epoch)
+        return steps
+
     def _apply_lm_window(self) -> None:
         """Windowed-LM length override (--num-steps): retarget the model's
         position table and the meta the batches are built from."""
@@ -385,6 +395,10 @@ class Trainer:
         t_epoch = time.time()
         t_window = time.time()
         window_iters = 0
+        epoch_steps = 0
+        max_steps = (
+            cfg.num_batches_per_epoch if cfg.num_batches_per_epoch else None
+        )
         metrics: dict = {}
         if self.meta.has_carry:
             # fresh hidden state each epoch (reference init_hidden per epoch)
@@ -405,6 +419,9 @@ class Trainer:
                 self.state, metrics = self.train_step(self.state, batch)
             self.iteration += 1
             window_iters += 1
+            epoch_steps += 1
+            if max_steps is not None and epoch_steps >= max_steps:
+                break
             if self.iteration % 10 == 0:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 dt = (time.time() - t_window) / max(window_iters, 1)

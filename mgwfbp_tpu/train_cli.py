@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-parallel", dest="seq_parallel", type=int, default=None)
     p.add_argument("--num-steps", dest="num_steps", type=int, default=None,
                    help="LM window length (must divide by --seq-parallel)")
+    p.add_argument("--num-batches-per-epoch", dest="num_batches_per_epoch",
+                   type=int, default=None,
+                   help="cap optimizer steps per epoch (smoke runs)")
     p.add_argument("--synthetic", action="store_true",
                    help="force synthetic data (no dataset files needed)")
     p.add_argument("--no-augment", action="store_true",
@@ -92,7 +95,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "nsteps_update", "policy", "threshold", "connection",
             "comm_profile", "dtype", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
-            "num_steps", "compressor", "density",
+            "num_steps", "num_batches_per_epoch", "compressor", "density",
         )
         if getattr(args, k, None) is not None
     }

@@ -73,6 +73,7 @@ def test_bf16_eval_counts_and_bounds(mesh):
     assert 0.0 <= float(out["top1"]) <= float(out["top5"]) <= n
 
 
+@pytest.mark.slow
 def test_bf16_bn_model_stats_stay_fp32(mesh):
     model, meta = zoo.create_model("resnet20")
     tx = sgd(0.1, momentum=0.9)

@@ -1,0 +1,75 @@
+"""Native C++ data-path kernels: build, bind, and bit-compare against the
+NumPy fallback (mgwfbp_tpu/native)."""
+
+import numpy as np
+import pytest
+
+from mgwfbp_tpu import native
+from mgwfbp_tpu.data.augment import FusedCropFlipNormalize, crop_at_offsets
+
+MEAN = np.asarray([0.49, 0.48, 0.45], np.float32)
+STD = np.asarray([0.2, 0.2, 0.2], np.float32)
+
+
+def _numpy_reference(x, ys, xs, flips, pad):
+    out = crop_at_offsets(x, ys, xs, pad)
+    out[flips] = out[flips, :, ::-1]
+    scale = (1.0 / (255.0 * STD)).astype(np.float32)
+    shift = (MEAN / STD).astype(np.float32)
+    return out.astype(np.float32) * scale - shift
+
+
+def test_native_builds_and_matches_numpy():
+    if not native.available():
+        pytest.skip("no C++ toolchain in this environment")
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 256, size=(6, 32, 32, 3)).astype(np.uint8)
+    ys = rs.randint(0, 9, size=6)
+    xs = rs.randint(0, 9, size=6)
+    flips = rs.rand(6) < 0.5
+    got = native.fused_crop_flip_normalize(
+        x, ys, xs, flips.astype(np.uint8), MEAN, STD, 4
+    )
+    want = _numpy_reference(x, ys, xs, flips, 4)
+    np.testing.assert_array_equal(got, want)  # same affine -> same bits
+
+
+def test_native_normalize_matches():
+    if not native.available():
+        pytest.skip("no C++ toolchain in this environment")
+    rs = np.random.RandomState(1)
+    x = rs.randint(0, 256, size=(4, 8, 8, 3)).astype(np.uint8)
+    got = native.normalize_u8(x, MEAN, STD)
+    scale = (1.0 / (255.0 * STD)).astype(np.float32)
+    shift = (MEAN / STD).astype(np.float32)
+    want = x.astype(np.float32) * scale - shift
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_transform_native_equals_fallback(monkeypatch):
+    """The loader transform must produce the same bytes whether or not the
+    native library loaded (same rng draw order on both paths)."""
+    if not native.available():
+        pytest.skip("no C++ toolchain in this environment")
+    tf = FusedCropFlipNormalize(MEAN, STD, pad=4)
+    rs = np.random.RandomState(2)
+    x = rs.randint(0, 256, size=(5, 32, 32, 3)).astype(np.uint8)
+    a = tf(x, np.random.default_rng([9]))  # native path
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    b = tf(x, np.random.default_rng([9]))  # numpy fallback, same seed
+    np.testing.assert_array_equal(a, b)  # bit-identical paths
+    assert a.dtype == np.float32 and a.shape == x.shape
+
+
+def test_fused_transform_fallback_without_native(monkeypatch):
+    import mgwfbp_tpu.native as nat
+
+    monkeypatch.setattr(nat, "_LIB", None)
+    monkeypatch.setattr(nat, "_TRIED", True)
+    tf = FusedCropFlipNormalize(MEAN, STD, pad=4)
+    rs = np.random.RandomState(3)
+    x = rs.randint(0, 256, size=(3, 32, 32, 3)).astype(np.uint8)
+    out = tf(x, np.random.default_rng([4]))
+    assert out.dtype == np.float32 and out.shape == x.shape
+    assert np.isfinite(out).all()
