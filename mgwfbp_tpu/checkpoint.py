@@ -58,6 +58,9 @@ class Checkpointer:
     def latest_epoch(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_epochs(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
     def restore(
         self, target_state: TrainState, epoch: Optional[int] = None
     ) -> Optional[Snapshot]:

@@ -21,6 +21,47 @@ from typing import Optional
 from mgwfbp_tpu.config import make_config
 
 
+def _eval_snapshots(
+    dnn: str,
+    checkpoint_root: str,
+    pick_epochs,
+    synthetic: Optional[bool] = None,
+    **config_overrides,
+):
+    """Shared driver: build ONE trainer, then restore + re-replicate +
+    evaluate each epoch `pick_epochs(ckpt)` selects, yielding metrics
+    incrementally (a failure at epoch k does not discard earlier results)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from mgwfbp_tpu.checkpoint import Checkpointer
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    cfg = make_config(dnn, checkpoint_dir=None, **config_overrides)
+    trainer = Trainer(cfg, profile_backward=False, synthetic_data=synthetic)
+    ckpt = Checkpointer(checkpoint_root)
+    try:
+        epochs = pick_epochs(ckpt)
+        for e in epochs:
+            snap = ckpt.restore(trainer.state, epoch=e)
+            if snap is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {checkpoint_root!r}"
+                    + (f" at epoch {e}" if e is not None else "")
+                )
+            # re-replicate over the mesh (the reference's post-load
+            # broadcast_parameters, dist_trainer.py:66)
+            trainer.state = jax.device_put(
+                snap.state, NamedSharding(trainer.mesh, PartitionSpec())
+            )
+            metrics = trainer.evaluate()
+            metrics["epoch"] = snap.epoch
+            yield metrics
+    finally:
+        ckpt.close()
+        trainer.close()
+
+
 def evaluate(
     dnn: str,
     checkpoint_root: str,
@@ -29,31 +70,35 @@ def evaluate(
     **config_overrides,
 ) -> dict:
     """Evaluate one checkpoint (latest by default); returns metrics dict."""
-    from mgwfbp_tpu.checkpoint import Checkpointer
-    from mgwfbp_tpu.train.trainer import Trainer
-
-    cfg = make_config(dnn, checkpoint_dir=None, **config_overrides)
-    trainer = Trainer(cfg, profile_backward=False, synthetic_data=synthetic)
-    ckpt = Checkpointer(checkpoint_root)
-    try:
-        snap = ckpt.restore(trainer.state, epoch=epoch)
-        if snap is None:
-            raise FileNotFoundError(
-                f"no checkpoint under {checkpoint_root!r}"
-                + (f" at epoch {epoch}" if epoch is not None else "")
-            )
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        trainer.state = jax.device_put(
-            snap.state, NamedSharding(trainer.mesh, PartitionSpec())
-        )
-        metrics = trainer.evaluate()
-        metrics["epoch"] = snap.epoch
+    for metrics in _eval_snapshots(
+        dnn, checkpoint_root, lambda ckpt: [epoch],
+        synthetic=synthetic, **config_overrides,
+    ):
         return metrics
-    finally:
-        ckpt.close()
-        trainer.close()
+    raise FileNotFoundError(f"no checkpoint under {checkpoint_root!r}")
+
+
+def evaluate_all(
+    dnn: str,
+    checkpoint_root: str,
+    synthetic: Optional[bool] = None,
+    **config_overrides,
+):
+    """Yield metrics for EVERY saved epoch in a run dir, in order (the
+    reference's scripts/eval.sh + evaluate.py loop over per-epoch
+    checkpoints)."""
+
+    def pick(ckpt):
+        epochs = ckpt.all_epochs()
+        if not epochs:
+            raise FileNotFoundError(
+                f"no checkpoints under {checkpoint_root!r}"
+            )
+        return epochs
+
+    yield from _eval_snapshots(
+        dnn, checkpoint_root, pick, synthetic=synthetic, **config_overrides
+    )
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -63,6 +108,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="the run's tagged checkpoint directory")
     p.add_argument("--epoch", type=int, default=None,
                    help="epoch to evaluate (default: latest)")
+    p.add_argument("--all-epochs", action="store_true",
+                   help="evaluate every saved epoch (one JSON line each); "
+                        "mutually exclusive with --epoch")
     p.add_argument("--dataset", default=None)
     p.add_argument("--data-dir", dest="data_dir", default=None)
     p.add_argument("--batch-size", dest="batch_size", type=int, default=None)
@@ -76,6 +124,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         for k in ("dataset", "data_dir", "batch_size")
         if getattr(args, k) is not None
     }
+    if args.all_epochs and args.epoch is not None:
+        p.error("--all-epochs and --epoch are mutually exclusive")
+    if args.all_epochs:
+        for metrics in evaluate_all(
+            args.dnn,
+            args.checkpoint_dir,
+            synthetic=True if args.synthetic else None,
+            **overrides,
+        ):
+            print(json.dumps(metrics))
+        return 0
     metrics = evaluate(
         args.dnn,
         args.checkpoint_dir,

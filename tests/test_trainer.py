@@ -278,6 +278,20 @@ def test_evaluate_cli_offline(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["epoch"] == 0 and "top1" in out
 
+    # --all-epochs: one JSON line per saved epoch (scripts/eval.sh loop)
+    rc = eval_main([
+        "--dnn", "mnistnet", "--checkpoint-dir", run_dir,
+        "--batch-size", "8", "--synthetic", "--all-epochs",
+    ])
+    assert rc == 0
+    lines = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert [m["epoch"] for m in lines] == [0]
+    assert all("top1" in m for m in lines)
+
 
 def test_calibrate_cli(tmp_path, capsys):
     from mgwfbp_tpu.calibrate import main as cal_main
