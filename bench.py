@@ -111,6 +111,7 @@ def _bench_policy(
             policy=policy,
             tb=tb if policy == "mgwfbp" else None,
             cost_model=lookup_alpha_beta("ici", max(n_dev, 2)),
+            comm_op=os.environ.get("MGWFBP_BENCH_COMM_OP", "all_reduce"),
         )
     # donate=True: the state buffers are reused in place across steps —
     # the production configuration (and ~4% faster than copying)

@@ -41,6 +41,7 @@ class TrainConfig:
     # gradient compression seam (reference compression.py, --compressor/--density)
     compressor: str = "none"  # none | topk
     density: float = 1.0  # kept fraction for sparsifying compressors
+    comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR-style RS+AG per bucket)
 
     # numerics
     dtype: str = "float32"  # param/compute dtype

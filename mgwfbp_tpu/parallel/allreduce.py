@@ -78,6 +78,29 @@ def arrival_order(
     return list(reversed(range(num_leaves)))
 
 
+def _rs_ag_allreduce(buf: jax.Array, axes, mean: bool) -> jax.Array:
+    """Bucket all-reduce as reduce-scatter + all-gather (the DeAR-style
+    decomposition, arXiv:2302.12445): each phase moves half a ring
+    all-reduce's bytes, and XLA may overlap the all-gather of group k with
+    other work more aggressively than a monolithic all-reduce. Numerically
+    identical to pmean/psum; buckets are padded to axis-size divisibility
+    for the scatter and trimmed after the gather."""
+    n = buf.shape[0]
+    # static world size: mesh axis extents are known at trace time
+    world = 1
+    for a in axes:
+        world *= lax.axis_size(a)
+    world = int(world)
+    pad = (-n) % world
+    if pad:
+        buf = jnp.pad(buf, (0, pad))
+    shard = lax.psum_scatter(buf, axes, scatter_dimension=0, tiled=True)
+    if mean:
+        shard = shard / world
+    full = lax.all_gather(shard, axes, axis=0, tiled=True)
+    return full[:n] if pad else full
+
+
 def merged_psum(
     tree: Any,
     layout: BucketLayout,
@@ -87,6 +110,7 @@ def merged_psum(
     comm_dtype: Optional[Any] = None,
     compressor: Optional[Any] = None,
     sequential: bool = True,
+    comm_op: str = "all_reduce",
 ) -> Any:
     """All-reduce a gradient pytree group-by-group per the bucket layout.
 
@@ -118,6 +142,15 @@ def merged_psum(
     partitioner on at least the CPU backend — verified empirically; the
     combiner then re-merges everything.)
     """
+    if comm_op not in ("all_reduce", "rs_ag"):
+        raise ValueError(
+            f"unknown comm_op {comm_op!r}; expected 'all_reduce' or 'rs_ag'"
+        )
+    if compressor is not None and comm_op != "all_reduce":
+        raise ValueError(
+            "comm_op='rs_ag' cannot combine with a sparsifying compressor "
+            "(the compressor replaces the bucket collective entirely)"
+        )
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arr = [leaves[j] for j in perm]
     shapes = [l.shape for l in arr]
@@ -138,6 +171,8 @@ def merged_psum(
             buf = buf + jnp.zeros((), buf.dtype) * clean.astype(buf.dtype)
         if compressor is not None and jnp.issubdtype(buf.dtype, jnp.floating):
             buf = compressor.allreduce(buf, axes, mean)
+        elif comm_op == "rs_ag":
+            buf = _rs_ag_allreduce(buf, axes, mean)
         else:
             buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
         token = buf[0]
@@ -168,6 +203,7 @@ class MergedAllreduce:
     comm_dtype: Optional[Any] = None
     compressor: Optional[Any] = None
     sequential: bool = True
+    comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR decomposition)
 
     def __call__(self, grads: Any) -> Any:
         return merged_psum(
@@ -179,6 +215,7 @@ class MergedAllreduce:
             comm_dtype=self.comm_dtype,
             compressor=self.compressor,
             sequential=self.sequential,
+            comm_op=self.comm_op,
         )
 
 
@@ -195,6 +232,7 @@ def make_merged_allreduce(
     mean: bool = True,
     comm_dtype: Optional[Any] = None,
     compressor: Optional[Any] = None,
+    comm_op: str = "all_reduce",
 ) -> MergedAllreduce:
     """Build the merged-allreduce transform for a parameter pytree.
 
@@ -271,4 +309,5 @@ def make_merged_allreduce(
         mean=mean,
         comm_dtype=comm_dtype,
         compressor=compressor,
+        comm_op=comm_op,
     )

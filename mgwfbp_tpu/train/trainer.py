@@ -269,6 +269,7 @@ class Trainer:
             threshold=cfg.threshold,
             comm_dtype=comm_dtype,
             compressor=compressor,
+            comm_op=cfg.comm_op,
         )
 
     def _profile_backward(self) -> Optional[list[float]]:

@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient compressor (reference --compressor)")
     p.add_argument("--density", type=float, default=None,
                    help="kept-fraction for sparsifying compressors")
+    p.add_argument("--comm-op", dest="comm_op", default=None,
+                   choices=["all_reduce", "rs_ag"],
+                   help="bucket collective: monolithic all-reduce or "
+                        "reduce-scatter + all-gather (DeAR-style)")
     p.add_argument("--no-profile-backward", action="store_true",
                    help="skip the offline backward benchmark (size prior)")
     p.add_argument("--epochs", type=int, default=None,
@@ -96,6 +100,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "comm_profile", "dtype", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
             "num_steps", "num_batches_per_epoch", "compressor", "density",
+            "comm_op",
         )
         if getattr(args, k, None) is not None
     }
