@@ -34,8 +34,9 @@ import time
 P100_RESNET50_IMG_S = 250.0
 
 # Peak dense-matmul FLOP/s per chip by device-kind substring (bf16 for TPU
-# generations — an UPPER bound for the fp32 programs benched here, so MFU is
-# conservative; tiny nominal value for CPU smoke runs).
+# generations — matches the bench's default bf16 compute dtype; for fp32
+# runs it is an upper bound, making MFU conservative. Tiny nominal value
+# keeps MFU meaningful in CPU smoke runs).
 _PEAK_FLOPS = [
     ("v5 lite", 197e12),  # TPU v5e
     ("v5e", 197e12),
@@ -90,7 +91,7 @@ def _bench_policy(
     policy, make_state, model, meta, tx, mesh, batch_dict, tb, iters,
     compute_dtype=None,
 ):
-    """Build the step for one policy, warm up, time with per-iter host sync.
+    """Build the step for one policy, warm up, time with windowed host sync.
 
     Returns (sec_per_iter, merge_groups, flops_per_step)."""
     import jax

@@ -1,0 +1,13 @@
+"""Custom TPU kernels (Pallas) for hot ops.
+
+The compute path of this framework is XLA-compiled JAX; Pallas kernels are
+reserved for ops where manual VMEM blocking beats XLA's fusions. The first
+resident: flash attention (ops/flashattn.py), used by the transformer's
+attention when enabled. Every kernel has a pure-jnp reference
+implementation and dispatch helpers that fall back when shapes don't
+qualify or the backend lacks Mosaic support.
+"""
+
+from mgwfbp_tpu.ops.flashattn import flash_attention, flash_supported
+
+__all__ = ["flash_attention", "flash_supported"]

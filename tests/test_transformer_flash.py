@@ -1,0 +1,26 @@
+"""Transformer with the Pallas flash-attention impl must match the dense
+impl (same params, same input)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mgwfbp_tpu.models.transformer import TransformerLM
+
+
+def test_transformer_flash_matches_dense():
+    model = TransformerLM(
+        vocab_size=50, d_model=32, num_heads=2, num_layers=2, d_ff=64,
+        max_len=64, dropout=0.0,
+    )
+    x = jnp.asarray(
+        np.random.RandomState(0).randint(0, 50, (2, 64)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    dense = model.apply({"params": params}, x, train=False)
+    flash = model.clone(attn_impl="flash").apply(
+        {"params": params}, x, train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(flash), rtol=2e-4, atol=2e-4
+    )
