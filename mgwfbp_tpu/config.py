@@ -56,6 +56,8 @@ class TrainConfig:
 
     # io / bookkeeping
     logdir: str = "./logs"
+    tensorboard: bool = False  # scalar event stream (reference's disabled
+    # tensorboardX seam, dist_trainer.py:136-137 — live here as JSONL)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 1
     pretrain: Optional[str] = None

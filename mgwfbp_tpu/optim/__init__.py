@@ -68,13 +68,21 @@ def make_optimizer(
     warmup_epochs: int = 5,
     num_batches_per_epoch: int = 1,
     norm_clip: Optional[float] = None,
+    step_offset: int = 0,
+    epoch_offset: float = 0.0,
 ) -> tuple[optax.GradientTransformation, EpochSchedule]:
-    """Build the full optimizer chain + its epoch schedule (for logging)."""
+    """Build the full optimizer chain + its epoch schedule (for logging).
+
+    step_offset/epoch_offset anchor the step->epoch conversion so an elastic
+    resize continues the schedule from its current position (as_step_fn)."""
     epoch_schedule = resolve(
         lr_schedule, base_lr, dataset=dataset, max_epochs=max_epochs,
         warmup_epochs=warmup_epochs,
     )
-    step_fn = as_step_fn(epoch_schedule, num_batches_per_epoch)
+    step_fn = as_step_fn(
+        epoch_schedule, num_batches_per_epoch,
+        step_offset=step_offset, epoch_offset=epoch_offset,
+    )
     tx = sgd(step_fn, momentum=momentum, weight_decay=weight_decay)
     if norm_clip is not None:
         tx = optax.chain(clip_by_global_norm(norm_clip), tx)

@@ -143,12 +143,23 @@ def resolve(
 
 
 def as_step_fn(
-    schedule: EpochSchedule, num_batches_per_epoch: int
+    schedule: EpochSchedule,
+    num_batches_per_epoch: int,
+    step_offset: int = 0,
+    epoch_offset: float = 0.0,
 ) -> Callable[[jnp.ndarray], jnp.ndarray]:
-    """`step -> lr` for use inside the jitted train step."""
+    """`step -> lr` for use inside the jitted train step.
+
+    The (step_offset, epoch_offset) anchor supports elastic resizes: after a
+    worker-count change alters batches-per-epoch, the epoch position must
+    CONTINUE from where training stood rather than re-deriving it from the
+    total carried-over step count with the new divisor (which would jump the
+    schedule discontinuously)."""
 
     def fn(step):
-        epoch = jnp.asarray(step, jnp.float32) / max(num_batches_per_epoch, 1)
+        epoch = epoch_offset + (
+            jnp.asarray(step, jnp.float32) - step_offset
+        ) / max(num_batches_per_epoch, 1)
         return schedule(epoch)
 
     return fn

@@ -54,19 +54,20 @@ class Trainer:
         synthetic_data: Optional[bool] = None,
     ):
         self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh(
+            MeshSpec(data=-1, seq=config.seq_parallel)
+        )
+        self.data_size = self.mesh.shape[DATA_AXIS]
+        # reflect the actual worker count into the config BEFORE anything
+        # consumes config.tag(): run tags / log dirs / checkpoint dirs must
+        # all distinguish 1-device from N-device runs, consistently
+        config.nworkers = self.data_size
         self.log = get_logger(
             "mgwfbp.trainer",
             logfile=os.path.join(config.logdir, config.tag(), "train.log")
             if config.logdir
             else None,
         )
-        self.mesh = mesh if mesh is not None else make_mesh(
-            MeshSpec(data=-1, seq=config.seq_parallel)
-        )
-        self.data_size = self.mesh.shape[DATA_AXIS]
-        # reflect the actual worker count into the config so run tags /
-        # checkpoint dirs distinguish 1-device from N-device runs
-        config.nworkers = self.data_size
         self.shard = ShardInfo(jax.process_index(), jax.process_count())
         # weak scaling: per-device batch (reference per-worker batch) times
         # the local extent of the data axis = this process's loader batch
@@ -107,17 +108,9 @@ class Trainer:
         image_hw = None
         if self.meta.task == "classify" and self.meta.input_shape[0] >= 256:
             image_hw = self.meta.input_shape[:2]  # inception 299
-        self.bundle = data_prepare(
-            config.dataset,
-            data_dir=config.data_dir,
-            batch_size=self.process_batch,
-            shard=self.shard,
-            seed=config.seed,
-            image_hw=image_hw,
-            synthetic=synthetic_data,
-            augment=config.augment,
-            num_steps=config.num_steps,
-        )
+        self._image_hw = image_hw
+        self._synthetic_data = synthetic_data
+        self.bundle = self._build_loaders()
         if self.bundle.num_classes != self.meta.num_classes:
             self.model, self.meta = zoo.create_model(
                 config.dnn, dataset=config.dataset,
@@ -126,6 +119,75 @@ class Trainer:
             # the rebuild reset meta/model to registry defaults; re-apply
             # the window-length override
             self._apply_lm_window()
+        # schedule anchor: epoch position the step->lr conversion continues
+        # from (moves only on elastic resizes, see update_nworker)
+        self._sched_step_offset = 0
+        self._sched_epoch_offset = 0.0
+        self._build_optimizer()
+        self.state = create_train_state(
+            jax.random.PRNGKey(config.seed),
+            self.model,
+            self._example_input(),
+            self.tx,
+        )
+        self._tb_cache = None  # measured backward profile, reused on resize
+        self._profile_backward_enabled = profile_backward
+        self.reducer = self._build_reducer(profile_backward)
+        if self.reducer is not None:
+            self.log.info(
+                "merge schedule: %d groups over %d tensors "
+                "(policy=%s, predicted nonoverlap %.3g s)",
+                self.reducer.schedule.num_groups,
+                len(self.reducer.schedule.layer_names),
+                config.policy,
+                self.reducer.schedule.predicted_nonoverlap_time,
+            )
+        self._build_steps()
+        self.checkpointer = None
+        if config.checkpoint_dir:
+            # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
+            # distinct experiments never share a resume directory
+            self.checkpointer = Checkpointer(
+                os.path.join(config.checkpoint_dir, config.tag())
+            )
+        # scalar event stream (reference's tensorboardX seam, live):
+        # process 0 only, like the reference's rank-gated writer
+        self.writer = None
+        if config.tensorboard and config.logdir and jax.process_index() == 0:
+            from mgwfbp_tpu.utils.summary import ScalarWriter
+
+            self.writer = ScalarWriter(
+                os.path.join(config.logdir, config.tag())
+            )
+        self.start_epoch = 0
+        self.iteration = 0
+        self.carry = None
+        self._maybe_resume()
+
+    # ------------------------------------------------------------------
+    def _build_loaders(self):
+        """Sharded data loaders at the current process batch (shared by
+        __init__ and update_nworker so the two can never drift)."""
+        return data_prepare(
+            self.config.dataset,
+            data_dir=self.config.data_dir,
+            batch_size=self.process_batch,
+            shard=self.shard,
+            seed=self.config.seed,
+            image_hw=self._image_hw,
+            synthetic=self._synthetic_data,
+            augment=self.config.augment,
+            num_steps=self.config.num_steps,
+        )
+
+    def _build_optimizer(self) -> None:
+        """(Re)build tx + the epoch LR schedule. The step->epoch conversion
+        inside the schedule is baked from the CURRENT loader length, so this
+        must rerun whenever the loaders change (e.g. update_nworker); the
+        (_sched_step_offset, _sched_epoch_offset) anchor makes the schedule
+        CONTINUE from its pre-resize position instead of re-deriving the
+        epoch from the carried-over step count with the new divisor."""
+        config = self.config
         self.tx, self.epoch_schedule = make_optimizer(
             config.lr,
             momentum=config.momentum,
@@ -141,23 +203,13 @@ class Trainer:
                 self._steps_per_epoch(), 1,
             ),
             norm_clip=config.norm_clip,
+            step_offset=self._sched_step_offset,
+            epoch_offset=self._sched_epoch_offset,
         )
-        self.state = create_train_state(
-            jax.random.PRNGKey(config.seed),
-            self.model,
-            self._example_input(),
-            self.tx,
-        )
-        self.reducer = self._build_reducer(profile_backward)
-        if self.reducer is not None:
-            self.log.info(
-                "merge schedule: %d groups over %d tensors "
-                "(policy=%s, predicted nonoverlap %.3g s)",
-                self.reducer.schedule.num_groups,
-                len(self.reducer.schedule.layer_names),
-                config.policy,
-                self.reducer.schedule.predicted_nonoverlap_time,
-            )
+
+    def _build_steps(self) -> None:
+        """(Re)build the jitted train/eval steps from the current
+        model/tx/mesh/reducer (shared by __init__ and update_nworker)."""
         step_model = (
             self.model.clone(seq_axis=self.seq_axis)
             if self.seq_axis
@@ -165,26 +217,14 @@ class Trainer:
         )
         self.train_step = make_train_step(
             step_model, self.meta, self.tx, self.mesh, self.reducer,
-            nsteps_update=config.nsteps_update, seq_axis=self.seq_axis,
+            nsteps_update=self.config.nsteps_update, seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
         )
         self.eval_step = make_eval_step(
             step_model, self.meta, self.mesh, seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
         )
-        self.checkpointer = None
-        if config.checkpoint_dir:
-            # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
-            # distinct experiments never share a resume directory
-            self.checkpointer = Checkpointer(
-                os.path.join(config.checkpoint_dir, config.tag())
-            )
-        self.start_epoch = 0
-        self.iteration = 0
-        self.carry = None
-        self._maybe_resume()
 
-    # ------------------------------------------------------------------
     def _steps_per_epoch(self) -> int:
         """Optimizer steps per epoch: loader batches / nsteps_update, capped
         by config.num_batches_per_epoch when set (smoke/CI runs)."""
@@ -194,6 +234,76 @@ class Trainer:
         if self.config.num_batches_per_epoch:
             steps = min(steps, self.config.num_batches_per_epoch)
         return steps
+
+    def update_nworker(self, nworkers: int) -> None:
+        """Elastic worker-count resize (reference `update_nworker`,
+        dl_trainer.py:545-566: re-rank + rebuild DistributedSampler/loaders
+        for a changed worker count — defined there but never called).
+
+        On TPU the worker count is the data-axis extent, so a resize is a
+        real reconfiguration, not just a sampler rebuild: the mesh shrinks or
+        grows over the local devices, the train state re-replicates onto the
+        new mesh, the data loaders re-shard (weak scaling keeps the
+        PER-DEVICE batch constant, so the process batch changes with the
+        extent), and — unlike the reference — the MG-WFBP merge schedule is
+        RE-SOLVED, because the α-β communication constants depend on the
+        world size. The measured backward profile is reused (per-device work
+        is unchanged under weak scaling).
+        """
+        if nworkers == self.data_size:
+            return
+        if jax.process_count() > 1:
+            # Cross-host elastic resize needs a coordinated device subset on
+            # every host plus loader re-ranking — out of scope, exactly as in
+            # the reference where update_nworker has no distributed caller.
+            raise NotImplementedError(
+                "update_nworker supports single-process (multi-device) runs; "
+                "multi-host resize requires relaunching with a new process set"
+            )
+        n_devices = nworkers * self.seq_size
+        avail = len(jax.devices())
+        if nworkers < 1 or n_devices > avail:
+            raise ValueError(
+                f"update_nworker({nworkers}): need {n_devices} devices "
+                f"(seq={self.seq_size}), have {avail}"
+            )
+        old = self.data_size
+        # advance the LR-schedule anchor to the CURRENT epoch position under
+        # the OLD loader length before anything is rebuilt, so the schedule
+        # continues smoothly across the resize instead of jumping when the
+        # step->epoch divisor changes
+        old_nbpe = max(self._steps_per_epoch(), 1)
+        step_now = int(self.state.step)
+        self._sched_epoch_offset += (
+            step_now - self._sched_step_offset
+        ) / old_nbpe
+        self._sched_step_offset = step_now
+        self.mesh = make_mesh(
+            MeshSpec(data=nworkers, seq=self.seq_size),
+            devices=jax.devices()[:n_devices],
+        )
+        self.data_size = nworkers
+        self.config.nworkers = nworkers
+        self.process_batch = self.config.batch_size * nworkers
+        # re-replicate state onto the new mesh (the reference's post-resize
+        # re-broadcast, expressed as a sharding constraint)
+        from mgwfbp_tpu.parallel.mesh import replicated_sharding
+
+        self.state = jax.device_put(self.state, replicated_sharding(self.mesh))
+        self.bundle = self._build_loaders()
+        # loader length changed with the process batch, so the LR schedule's
+        # step->epoch conversion must be re-baked; the optax chain structure
+        # is unchanged, so the existing opt_state (momentum) carries over
+        self._build_optimizer()
+        self.reducer = self._build_reducer(self._profile_backward_enabled)
+        self._build_steps()
+        self.carry = None  # old carry is sized for the old process batch
+        self.log.info(
+            "update_nworker: resized data axis %d -> %d (process batch %d%s)",
+            old, nworkers, self.process_batch,
+            "" if self.reducer is None
+            else f", merge schedule re-solved: {self.reducer.schedule.num_groups} groups",
+        )
 
     def _apply_lm_window(self) -> None:
         """Windowed-LM length override (--num-steps): retarget the model's
@@ -242,7 +352,11 @@ class Trainer:
             cost_model = lookup_alpha_beta(cfg.connection, self.data_size)
         tb = None
         if cfg.policy == "mgwfbp" and profile_backward:
-            tb = self._profile_backward()
+            if self._tb_cache is None:
+                self._tb_cache = self._profile_backward()
+            # tb is per-device backward time at the per-device batch, which
+            # weak scaling holds constant — reusable across worker resizes
+            tb = self._tb_cache
         comm_dtype = (
             jnp.dtype(cfg.comm_dtype) if cfg.comm_dtype else None
         )
@@ -435,6 +549,15 @@ class Trainer:
                     ),
                     dt, global_batch / dt,
                 )
+                if self.writer is not None:
+                    self.writer.add_scalars("train", metrics, self.iteration)
+                    self.writer.add_scalar(
+                        "train/sec_per_iter", dt, self.iteration
+                    )
+                    self.writer.add_scalar(
+                        "train/samples_per_sec", global_batch / dt,
+                        self.iteration,
+                    )
                 t_window = time.time()
                 window_iters = 0
         if micro:
@@ -566,6 +689,8 @@ class Trainer:
     def close(self) -> None:
         if self.checkpointer is not None:
             self.checkpointer.close()
+        if self.writer is not None:
+            self.writer.close()
 
     def load_checkpoint(self, directory: str, epoch: Optional[int] = None):
         """Restore a snapshot from a checkpoint dir onto this trainer's mesh
@@ -639,6 +764,8 @@ class Trainer:
         for epoch in range(self.start_epoch, end):
             train_metrics = self.train_epoch(epoch)
             metrics = {"train": train_metrics}
+            if self.writer is not None:
+                self.writer.add_scalars("epoch", train_metrics, epoch)
             if (epoch + 1) % cfg.eval_every_epochs == 0:
                 eval_metrics = self.evaluate()
                 metrics["eval"] = eval_metrics
@@ -646,6 +773,8 @@ class Trainer:
                     "epoch %d eval: %s", epoch,
                     ", ".join(f"{k} {v:.4f}" for k, v in eval_metrics.items()),
                 )
+                if self.writer is not None:
+                    self.writer.add_scalars("eval", eval_metrics, epoch)
             if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 self.save(epoch)
         if self.checkpointer is not None:

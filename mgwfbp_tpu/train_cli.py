@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force synthetic data (no dataset files needed)")
     p.add_argument("--no-augment", action="store_true",
                    help="disable training-time data augmentation")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="stream scalar events to <logdir>/<tag>/events.jsonl "
+                        "(mirrors into TensorBoard files if tensorboardX is "
+                        "installed)")
     p.add_argument("--compressor", default=None,
                    choices=["none", "topk"],
                    help="gradient compressor (reference --compressor)")
@@ -106,6 +110,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     }
     if args.no_augment:
         overrides["augment"] = False
+    if args.tensorboard:
+        overrides["tensorboard"] = True
     return make_config(args.dnn, **overrides)
 
 
