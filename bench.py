@@ -9,10 +9,12 @@ Protocol (VERDICT r2 task #2 — a number that survives scrutiny):
     the reference's whole experimental method is this A/B grid
     (reference batch_dist_mpi.sh:1-17, settings.py:34 ORIGINAL_HOROVOD);
   * the timed loop is host-synchronized by pulling a scalar computed by
-    the chained steps once per 10-step window (and at the end), so the
-    timer brackets real device execution even if block_until_ready were a
-    no-op through an experimental backend, without paying one tunnel
-    round-trip per step (MGWFBP_BENCH_SYNC=iter restores per-step pulls);
+    the LAST chained step: steps chain through donated state, so the device
+    runs them strictly in order and the final pull brackets the whole
+    region — real device execution even if block_until_ready were a no-op
+    through an experimental backend. Intermediate pulls are avoided because
+    one tunnel round trip costs ~50 ms here (MGWFBP_BENCH_SYNC=iter|window
+    restores per-step/per-10-step pulls for harness A/B);
   * >= 50 timed iterations at the model's PRESET per-worker batch
     (resnet50: 128, reference exp_configs/resnet50.conf), falling back to
     batch 64 only on OOM (reported in the payload);
@@ -139,14 +141,23 @@ def _bench_policy(
         state, metrics = run(state, batch_dict)
     float(metrics["loss"])
 
-    # Sync discipline: every step chains through `state`, so pulling a
-    # scalar computed by step i forces the device to have executed steps
-    # 1..i. Pulling every iteration adds one full host<->device round trip
-    # per step (material through a network tunnel); the default pulls once
-    # per 10-step window, which still brackets real execution while
-    # amortizing the transfer. MGWFBP_BENCH_SYNC=iter restores per-step
-    # pulls for A/B-ing the harness itself.
-    window = 1 if os.environ.get("MGWFBP_BENCH_SYNC") == "iter" else 10
+    # Sync discipline: every step chains through `state` (donated), so the
+    # device executes steps strictly in order and pulling a scalar computed
+    # by step i forces steps 1..i to have run. ONE pull after the last step
+    # therefore brackets the whole timed region exactly. Each extra pull
+    # costs a full host<->device round trip — measured at ~50 ms through
+    # this chip's network tunnel (per-step pulls: 139 ms/step vs 53 ms at
+    # end-only sync for the same program) — so intermediate pulls would
+    # time the tunnel, not the device. MGWFBP_BENCH_SYNC=iter|window
+    # restores per-step / per-10-step pulls for A/B-ing the harness.
+    sync_mode = os.environ.get("MGWFBP_BENCH_SYNC", "end")
+    windows = {"iter": 1, "window": 10, "end": iters}
+    if sync_mode not in windows:
+        raise ValueError(
+            f"MGWFBP_BENCH_SYNC={sync_mode!r}: expected one of "
+            f"{sorted(windows)}"
+        )
+    window = windows[sync_mode]
     loss = None
     t0 = time.perf_counter()
     for i in range(iters):

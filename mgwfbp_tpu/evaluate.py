@@ -21,6 +21,30 @@ from typing import Optional
 from mgwfbp_tpu.config import make_config
 
 
+def _install_and_eval(trainer, state) -> dict:
+    """Re-replicate a restored train state over the trainer's mesh (the
+    reference's post-load broadcast_parameters, dist_trainer.py:66) and run
+    the eval loop. Single seam shared by the per-epoch and model-average
+    paths."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    trainer.state = jax.device_put(
+        state, NamedSharding(trainer.mesh, PartitionSpec())
+    )
+    return trainer.evaluate()
+
+
+def _restore_or_raise(ckpt, root: str, template, epoch: Optional[int]):
+    snap = ckpt.restore(template, epoch=epoch)
+    if snap is None:
+        raise FileNotFoundError(
+            f"no checkpoint under {root!r}"
+            + (f" at epoch {epoch}" if epoch is not None else "")
+        )
+    return snap
+
+
 def _eval_snapshots(
     dnn: str,
     checkpoint_root: str,
@@ -31,9 +55,6 @@ def _eval_snapshots(
     """Shared driver: build ONE trainer, then restore + re-replicate +
     evaluate each epoch `pick_epochs(ckpt)` selects, yielding metrics
     incrementally (a failure at epoch k does not discard earlier results)."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec
-
     from mgwfbp_tpu.checkpoint import Checkpointer
     from mgwfbp_tpu.train.trainer import Trainer
 
@@ -43,18 +64,8 @@ def _eval_snapshots(
     try:
         epochs = pick_epochs(ckpt)
         for e in epochs:
-            snap = ckpt.restore(trainer.state, epoch=e)
-            if snap is None:
-                raise FileNotFoundError(
-                    f"no checkpoint under {checkpoint_root!r}"
-                    + (f" at epoch {e}" if e is not None else "")
-                )
-            # re-replicate over the mesh (the reference's post-load
-            # broadcast_parameters, dist_trainer.py:66)
-            trainer.state = jax.device_put(
-                snap.state, NamedSharding(trainer.mesh, PartitionSpec())
-            )
-            metrics = trainer.evaluate()
+            snap = _restore_or_raise(ckpt, checkpoint_root, trainer.state, e)
+            metrics = _install_and_eval(trainer, snap.state)
             metrics["epoch"] = snap.epoch
             yield metrics
     finally:
@@ -101,16 +112,89 @@ def evaluate_all(
     )
 
 
+def model_average_evaluate(
+    dnn: str,
+    checkpoint_roots: list[str],
+    epoch: Optional[int] = None,
+    synthetic: Optional[bool] = None,
+    **config_overrides,
+) -> dict:
+    """Average model weights across several runs' checkpoints, then evaluate
+    the averaged model (reference evaluate.py:10-18 `model_average` —
+    elementwise state-dict mean over per-rank checkpoints, shipped there
+    behind a disabled branch at :36; live here).
+
+    Each root is one run's tagged checkpoint directory. All roots must hold
+    a checkpoint at the SAME epoch — with epoch=None each root's latest is
+    restored and a mismatch (runs of different lengths, or one root's epoch
+    pruned by retention) raises instead of silently averaging weights from
+    different training stages."""
+    import jax
+    import jax.numpy as jnp
+
+    from mgwfbp_tpu.checkpoint import Checkpointer
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    if not checkpoint_roots:
+        raise ValueError("model_average_evaluate: no checkpoint dirs given")
+    cfg = make_config(dnn, checkpoint_dir=None, **config_overrides)
+    trainer = Trainer(cfg, profile_backward=False, synthetic_data=synthetic)
+    try:
+        snaps = []
+        for root in checkpoint_roots:
+            ckpt = Checkpointer(root)
+            try:
+                snaps.append(
+                    _restore_or_raise(ckpt, root, trainer.state, epoch)
+                )
+            finally:
+                ckpt.close()
+        epochs = sorted({s.epoch for s in snaps})
+        if len(epochs) > 1:
+            raise ValueError(
+                "model_average_evaluate: checkpoint roots are at different "
+                f"epochs {epochs}; pass --epoch to pick a common one"
+            )
+        n = float(len(snaps))
+
+        def mean(*leaves):
+            acc = leaves[0].astype(jnp.float32)
+            for x in leaves[1:]:
+                acc = acc + x.astype(jnp.float32)
+            return (acc / n).astype(leaves[0].dtype)
+
+        params = jax.tree_util.tree_map(
+            mean, *[s.state.params for s in snaps]
+        )
+        batch_stats = jax.tree_util.tree_map(
+            mean, *[s.state.batch_stats for s in snaps]
+        )
+        metrics = _install_and_eval(
+            trainer,
+            trainer.state.replace(params=params, batch_stats=batch_stats),
+        )
+        metrics["epoch"] = snaps[0].epoch
+        metrics["averaged_over"] = len(snaps)
+        return metrics
+    finally:
+        trainer.close()
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="mgwfbp-evaluate")
     p.add_argument("--dnn", required=True)
-    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", required=True,
-                   help="the run's tagged checkpoint directory")
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
+                   help="the run's tagged checkpoint directory (required "
+                        "unless --average-dirs is used)")
     p.add_argument("--epoch", type=int, default=None,
                    help="epoch to evaluate (default: latest)")
     p.add_argument("--all-epochs", action="store_true",
                    help="evaluate every saved epoch (one JSON line each); "
                         "mutually exclusive with --epoch")
+    p.add_argument("--average-dirs", dest="average_dirs", nargs="+",
+                   default=None,
+                   help="average weights across these runs' checkpoints "
+                        "before evaluating (reference model_average)")
     p.add_argument("--dataset", default=None)
     p.add_argument("--data-dir", dest="data_dir", default=None)
     p.add_argument("--batch-size", dest="batch_size", type=int, default=None)
@@ -126,6 +210,20 @@ def main(argv: Optional[list[str]] = None) -> int:
     }
     if args.all_epochs and args.epoch is not None:
         p.error("--all-epochs and --epoch are mutually exclusive")
+    if args.average_dirs and args.all_epochs:
+        p.error("--average-dirs and --all-epochs are mutually exclusive")
+    if not args.average_dirs and not args.checkpoint_dir:
+        p.error("--checkpoint-dir is required (or use --average-dirs)")
+    if args.average_dirs:
+        metrics = model_average_evaluate(
+            args.dnn,
+            args.average_dirs,
+            epoch=args.epoch,
+            synthetic=True if args.synthetic else None,
+            **overrides,
+        )
+        print(json.dumps(metrics))
+        return 0
     if args.all_epochs:
         for metrics in evaluate_all(
             args.dnn,

@@ -143,22 +143,7 @@ class Trainer:
                 self.reducer.schedule.predicted_nonoverlap_time,
             )
         self._build_steps()
-        self.checkpointer = None
-        if config.checkpoint_dir:
-            # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
-            # distinct experiments never share a resume directory
-            self.checkpointer = Checkpointer(
-                os.path.join(config.checkpoint_dir, config.tag())
-            )
-        # scalar event stream (reference's tensorboardX seam, live):
-        # process 0 only, like the reference's rank-gated writer
-        self.writer = None
-        if config.tensorboard and config.logdir and jax.process_index() == 0:
-            from mgwfbp_tpu.utils.summary import ScalarWriter
-
-            self.writer = ScalarWriter(
-                os.path.join(config.logdir, config.tag())
-            )
+        self._build_run_sinks()
         self.start_epoch = 0
         self.iteration = 0
         self.carry = None
@@ -224,6 +209,42 @@ class Trainer:
             step_model, self.meta, self.mesh, seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
         )
+
+    def _build_run_sinks(self) -> None:
+        """(Re)bind every tag-addressed output — log file, checkpoint dir,
+        scalar event stream — to the CURRENT config.tag(). Runs at init and
+        again whenever the tag changes (update_nworker changes nworkers),
+        so checkpoints/events never keep landing under a stale tag that a
+        relaunch at the new size would not look in."""
+        config = self.config
+        self.log = get_logger(
+            "mgwfbp.trainer",
+            logfile=os.path.join(config.logdir, config.tag(), "train.log")
+            if config.logdir
+            else None,
+        )
+        old_ckpt = getattr(self, "checkpointer", None)
+        if old_ckpt is not None:
+            old_ckpt.close()
+        self.checkpointer = None
+        if config.checkpoint_dir:
+            # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
+            # distinct experiments never share a resume directory
+            self.checkpointer = Checkpointer(
+                os.path.join(config.checkpoint_dir, config.tag())
+            )
+        old_writer = getattr(self, "writer", None)
+        if old_writer is not None:
+            old_writer.close()
+        # scalar event stream (reference's tensorboardX seam, live):
+        # process 0 only, like the reference's rank-gated writer
+        self.writer = None
+        if config.tensorboard and config.logdir and jax.process_index() == 0:
+            from mgwfbp_tpu.utils.summary import ScalarWriter
+
+            self.writer = ScalarWriter(
+                os.path.join(config.logdir, config.tag())
+            )
 
     def _steps_per_epoch(self) -> int:
         """Optimizer steps per epoch: loader batches / nsteps_update, capped
@@ -297,6 +318,9 @@ class Trainer:
         self._build_optimizer()
         self.reducer = self._build_reducer(self._profile_backward_enabled)
         self._build_steps()
+        # the run tag changed with nworkers: re-point log/checkpoint/event
+        # sinks so post-resize output is found by a relaunch at the new size
+        self._build_run_sinks()
         self.carry = None  # old carry is sized for the old process batch
         self.log.info(
             "update_nworker: resized data axis %d -> %d (process batch %d%s)",

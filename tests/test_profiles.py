@@ -52,3 +52,46 @@ def test_tb_attribution_artifact_orders_differently_than_volume():
     prior = art["conv_share_volume_prior"]
     assert measured > 0.3 > prior * 100
     assert sum(art["tb_measured_s"]) > 0
+
+
+def test_scaling_harness_cpu8_artifact():
+    """The committed weak-scaling artifact (tools/scaling_efficiency.py on
+    the 8-device CPU mesh) must carry the measured extents and solver
+    predictions with mgwfbp no worse than wfbp at every predicted target."""
+    with open(os.path.join(PROFILES, "scaling_cpu8.json")) as f:
+        d = json.load(f)
+    m = d["measured_weak_scaling"]
+    assert set(m) >= {"1", "2", "4", "8"}
+    assert m["1"]["efficiency"] == 1.0
+    for n in ("2", "4", "8"):
+        assert 0.0 < m[n]["efficiency"] <= 1.05
+        assert m[n]["merge_groups"] >= 1
+    for target, td in d["predicted_targets"].items():
+        pol = td["policies"]
+        assert (
+            pol["mgwfbp"]["predicted_nonoverlap_s"]
+            <= pol["wfbp"]["predicted_nonoverlap_s"] + 1e-12
+        ), target
+        for p in pol.values():
+            assert 0.0 < p["predicted_efficiency"] <= 1.0
+
+
+def test_scaling_harness_runs_small(tmp_path):
+    """Harness smoke: tiny model, 2 extents, writes a parseable artifact."""
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+    )
+    import scaling_efficiency
+
+    out = str(tmp_path / "s.json")
+    rc = scaling_efficiency.main([
+        "--model", "mnistnet", "--batch", "4", "--iters", "3",
+        "--warmup", "1", "--targets", "v5e-4", "--out", out,
+    ])
+    assert rc == 0
+    with open(out) as f:
+        d = json.load(f)
+    assert d["measured_weak_scaling"]["1"]["sec_per_iter"] > 0
+    assert "v5e-4" in d["predicted_targets"]

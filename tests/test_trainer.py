@@ -398,3 +398,74 @@ def test_logdir_and_events_share_run_tag(tmp_path):
     assert "-n8-" in cfg.tag()
     assert os.path.exists(os.path.join(rundir, "train.log"))
     assert os.path.exists(os.path.join(rundir, "events.jsonl"))
+
+
+def test_evaluate_model_average(tmp_path, capsys):
+    """--average-dirs evaluates the elementwise mean of several runs' weights
+    (reference model_average, evaluate.py:10-18, disabled there at :36).
+    Averaging two DIFFERENT runs must produce a valid eval, and averaging a
+    run with itself must reproduce that run's own eval exactly."""
+    runs = []
+    for seed in (3, 4):
+        cfg = _cfg(checkpoint_dir=str(tmp_path / f"s{seed}"), seed=seed,
+                   num_batches_per_epoch=8)
+        t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+        t.fit(1)
+        t.checkpointer.wait()
+        runs.append(t.checkpointer._dir)
+        t.close()
+
+    from mgwfbp_tpu.evaluate import evaluate, main as eval_main, \
+        model_average_evaluate
+
+    solo = evaluate("mnistnet", runs[0], synthetic=True, batch_size=8)
+    self_avg = model_average_evaluate(
+        "mnistnet", [runs[0], runs[0]], synthetic=True, batch_size=8,
+    )
+    assert self_avg["top1"] == pytest.approx(solo["top1"], abs=1e-6)
+    assert self_avg["averaged_over"] == 2
+
+    rc = eval_main([
+        "--dnn", "mnistnet", "--average-dirs", runs[0], runs[1],
+        "--batch-size", "8", "--synthetic",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["averaged_over"] == 2 and 0.0 <= out["top1"] <= 1.0
+
+
+def test_update_nworker_repoints_checkpoint_dir(tmp_path):
+    """After a resize the run tag changes; checkpoints must land under the
+    NEW tag so a relaunch at the new size resumes them."""
+    cfg = _cfg(checkpoint_dir=str(tmp_path), num_batches_per_epoch=2)
+    t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    assert "-n8-" in t.checkpointer._dir
+    t.train_epoch(0)
+    t.update_nworker(4)
+    assert "-n4-" in t.checkpointer._dir
+    t.save(0)
+    t.checkpointer.wait()
+    t.close()
+    t2 = Trainer(cfg, synthetic_data=True, profile_backward=False,
+                 mesh=__import__("mgwfbp_tpu.parallel.mesh", fromlist=["x"])
+                 .make_mesh(
+                     __import__("mgwfbp_tpu.parallel.mesh", fromlist=["x"])
+                     .MeshSpec(data=4), devices=jax.devices()[:4]))
+    assert t2.start_epoch == 1  # resumed from the -n4- checkpoint
+    t2.close()
+
+
+def test_model_average_rejects_mismatched_epochs(tmp_path):
+    from mgwfbp_tpu.evaluate import model_average_evaluate
+
+    dirs = []
+    for seed, epochs in ((5, 1), (6, 2)):
+        cfg = _cfg(checkpoint_dir=str(tmp_path / f"e{seed}"), seed=seed,
+                   num_batches_per_epoch=2)
+        t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+        t.fit(epochs)
+        t.checkpointer.wait()
+        dirs.append(t.checkpointer._dir)
+        t.close()
+    with pytest.raises(ValueError, match="different epochs"):
+        model_average_evaluate("mnistnet", dirs, synthetic=True, batch_size=8)
