@@ -61,7 +61,7 @@ def make_optimizer(
     base_lr: float,
     *,
     momentum: float = 0.9,
-    weight_decay: float = 5e-4,
+    weight_decay: float = 1e-4,  # reference default (dl_trainer.py:216)
     lr_schedule: str = "auto",
     dataset: str = "cifar10",
     max_epochs: int = 141,

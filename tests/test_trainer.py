@@ -469,3 +469,27 @@ def test_model_average_rejects_mismatched_epochs(tmp_path):
         t.close()
     with pytest.raises(ValueError, match="different epochs"):
         model_average_evaluate("mnistnet", dirs, synthetic=True, batch_size=8)
+
+
+def test_preset_optimizer_constants_match_reference():
+    """Per-dataset SGD constants (reference dl_trainer.py:216-229): imagenet
+    momentum 0.875 / wd 2*3.0517578125e-05, ptb momentum 0 / wd 0, everything
+    else momentum 0.9 / wd 1e-4 (the an4 wd-zeroing there is commented out)."""
+    from mgwfbp_tpu.config import PRESETS
+
+    imagenet_models = [
+        n for n, p in PRESETS.items() if p.get("dataset") == "imagenet"
+    ]
+    assert len(imagenet_models) >= 9
+    for name in imagenet_models:
+        cfg = make_config(name)
+        assert cfg.momentum == 0.875, name
+        assert cfg.weight_decay == pytest.approx(2 * 3.0517578125e-05), name
+    lstm = make_config("lstm")
+    assert lstm.momentum == 0.0 and lstm.weight_decay == 0.0
+    an4 = make_config("lstman4")
+    assert an4.momentum == 0.9 and an4.weight_decay == pytest.approx(1e-4)
+    for name in ("resnet20", "vgg16", "mnistnet", "lenet"):
+        cfg = make_config(name)
+        assert cfg.momentum == 0.9, name
+        assert cfg.weight_decay == pytest.approx(1e-4), name
