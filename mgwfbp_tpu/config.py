@@ -75,8 +75,13 @@ class TrainConfig:
 
 # Per-model presets — parity with exp_configs/*.conf (values cited in
 # BASELINE.md "Headline training configs" and reference exp_configs/).
-# ImageNet SGD constants: reference dl_trainer.py:226-229.
-_IMAGENET_SGD = dict(momentum=0.875, weight_decay=2 * 3.0517578125e-05)
+# Dataset-keyed SGD constants (the reference selects them by DATASET,
+# dl_trainer.py:216-229); make_config fills them for any model trained
+# on that dataset unless the preset or caller overrides.
+_DATASET_SGD: dict[str, dict] = {
+    "imagenet": dict(momentum=0.875, weight_decay=2 * 3.0517578125e-05),
+    "ptb": dict(momentum=0.0, weight_decay=0.0),
+}
 PRESETS: dict[str, dict] = {
     "mnistnet": dict(dataset="mnist", batch_size=64, lr=0.01, max_epochs=10),
     "lenet": dict(dataset="mnist", batch_size=64, lr=0.01, max_epochs=10),
@@ -85,28 +90,17 @@ PRESETS: dict[str, dict] = {
     "resnet110": dict(dataset="cifar10", batch_size=32, lr=0.1, max_epochs=141),
     "vgg16": dict(dataset="cifar10", batch_size=128, lr=0.1, max_epochs=141,
                   lr_schedule="vgg"),
-    "resnet50": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "resnet152": dict(dataset="imagenet", batch_size=32, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "densenet121": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "densenet161": dict(dataset="imagenet", batch_size=32, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "densenet201": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "googlenet": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "inceptionv3": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "inceptionv4": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    "alexnet": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70,
-                     **_IMAGENET_SGD),
-    # ptb: momentum 0, no decay (reference dl_trainer.py:223-225)
+    "resnet50": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70),
+    "resnet152": dict(dataset="imagenet", batch_size=32, lr=0.01, max_epochs=70),
+    "densenet121": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "densenet161": dict(dataset="imagenet", batch_size=32, lr=0.01, max_epochs=70),
+    "densenet201": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "googlenet": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "inceptionv3": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "inceptionv4": dict(dataset="imagenet", batch_size=64, lr=0.01, max_epochs=70),
+    "alexnet": dict(dataset="imagenet", batch_size=128, lr=0.01, max_epochs=70),
     "lstm": dict(dataset="ptb", batch_size=20, lr=22.0, max_epochs=40,
-                 lr_schedule="ptb", norm_clip=0.25, weight_decay=0.0,
-                 momentum=0.0),
+                 lr_schedule="ptb", norm_clip=0.25),
     # TPU long-context extension (no reference analogue): windowed LM with
     # ring attention; 64-token windows divide by seq extents 2/4/8
     "transformer": dict(dataset="ptb", batch_size=16, lr=1.0, max_epochs=40,
@@ -137,6 +131,9 @@ def make_config(dnn: str, **overrides) -> TrainConfig:
         if env is not None:
             base[field.name] = _coerce(env, field.type)
     base.update({k: v for k, v in overrides.items() if v is not None})
+    # dataset-keyed SGD constants fill any key no preset/env/caller set
+    for k, v in _DATASET_SGD.get(base.get("dataset", "cifar10"), {}).items():
+        base.setdefault(k, v)
     return TrainConfig(**base)
 
 

@@ -71,16 +71,18 @@ def vgg_halving(base_lr: float, every: int = 25) -> EpochSchedule:
     return fn
 
 
-def ptb_staircase(
-    base_lr: float, decay_start: int = 6, decay: float = 1.2
-) -> EpochSchedule:
-    """Hold, then divide by `decay` each epoch past `decay_start` (reference
-    :595-610; classic PTB large-LSTM recipe — base lr 22)."""
+def ptb_staircase(base_lr: float) -> EpochSchedule:
+    """The reference's PTB LSTM staircase (dl_trainer.py:595-610): base LR
+    until epoch 63 (`first = 23+40`), then x0.01 until 80, then x0.001.
+    Note the reference's `second = 60 < first` branch is dead — there is no
+    x0.1 step — and its lstm config runs 40 epochs, so within a standard run
+    the LR stays at base (22) throughout; reproduced exactly."""
 
     def fn(epoch):
         epoch = jnp.asarray(epoch, jnp.float32)
-        k = jnp.clip(jnp.floor(epoch) - decay_start + 1, 0.0, None)
-        return base_lr * jnp.power(1.0 / decay, k)
+        return base_lr * jnp.where(
+            epoch < 63, 1.0, jnp.where(epoch < 80, 0.01, 0.001)
+        )
 
     return fn
 

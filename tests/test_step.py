@@ -232,9 +232,13 @@ def test_schedules_shapes_and_values():
     assert float(s(5.0)) == pytest.approx(0.1)
     assert float(s(100.0)) == pytest.approx(0.01)  # past 81
     assert float(s(130.0)) == pytest.approx(0.001)  # past 122
+    # reference PTB staircase (dl_trainer.py:595-610): base through its
+    # 40-epoch run (first milestone at 63), x0.01 at 63, x0.001 at 80
     p = resolve("ptb", 22.0)
     assert float(p(0.0)) == pytest.approx(22.0)
-    assert float(p(7.0)) < 22.0
+    assert float(p(40.0)) == pytest.approx(22.0)
+    assert float(p(63.0)) == pytest.approx(0.22)
+    assert float(p(80.0)) == pytest.approx(0.022)
     a = resolve("anneal", 1.0)
     assert float(a(10.0)) == pytest.approx(1.0 / 1.01**10)
     v = resolve("vgg", 0.1)
