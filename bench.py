@@ -269,7 +269,13 @@ def run_bench() -> dict:
         batch = 64
         global_batch, tb, results = run_grid(batch)
 
-    main = results["mgwfbp"]
+    # Headline = the PRODUCTION configuration. On one device the Trainer
+    # skips the reducer entirely (reference single-path parity:
+    # train_with_single never wraps the optimizer), which is exactly the
+    # 'none' row; the instrumented mgwfbp row stays in `policies` so the
+    # no-op-dispatch overhead remains visible.
+    headline_policy = "none" if n_dev == 1 else "mgwfbp"
+    main = results[headline_policy]
     dt = main["sec_per_iter"]
     img_s = main["images_per_sec"]
     flops = main["flops_per_step"]
@@ -283,7 +289,9 @@ def run_bench() -> dict:
         "value": img_s,
         "unit": "images/s",
         "vs_baseline": round(img_s / P100_RESNET50_IMG_S, 3),
-        "policy": "mgwfbp",
+        # the row the headline numbers actually come from; the single-device
+        # production rationale lives in "note"
+        "policy": headline_policy,
         "n_devices": n_dev,
         "device_kind": devices[0].device_kind,
         "batch_per_device": batch,
@@ -304,7 +312,10 @@ def run_bench() -> dict:
         payload["flops_per_step"] = flops
     if n_dev == 1:
         payload["note"] = (
-            "single chip: collectives are no-ops, so the XLA-fused oracle "
+            "single chip: headline is the PRODUCTION configuration — the "
+            "Trainer skips the reducer at world size 1 (reference "
+            "single-path parity), i.e. the 'none' row. Collectives are "
+            "no-ops here, so the XLA-fused oracle "
             "('none'/'single') is the ceiling and merge scheduling can only "
             "add dispatch overhead; MG-WFBP's advantage needs real "
             "inter-chip communication (compare policies on a multi-chip "

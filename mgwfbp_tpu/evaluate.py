@@ -225,6 +225,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(json.dumps(metrics))
         return 0
     if args.all_epochs:
+        # running best across epochs (reference evaluate.py:47-57: higher is
+        # better for accuracy, lower for lstm perplexity / an4 WER)
+        best = None
+        best_epoch = None
         for metrics in evaluate_all(
             args.dnn,
             args.checkpoint_dir,
@@ -232,6 +236,21 @@ def main(argv: Optional[list[str]] = None) -> int:
             **overrides,
         ):
             print(json.dumps(metrics))
+            if "wer" in metrics:
+                key, lower_better = "wer", True
+            elif "perplexity" in metrics:
+                key, lower_better = "perplexity", True
+            else:
+                key, lower_better = "top1", False
+            v = metrics.get(key)
+            if v is not None and (
+                best is None or (v < best if lower_better else v > best)
+            ):
+                best, best_epoch = v, metrics.get("epoch")
+        if best is not None:
+            print(json.dumps(
+                {"best": {key: best, "epoch": best_epoch}}
+            ))
         return 0
     metrics = evaluate(
         args.dnn,

@@ -40,15 +40,19 @@ def test_trainer_end_to_end_mnist(tmp_path):
 
 @pytest.mark.slow
 def test_trainer_policies_same_loss():
-    # wfbp / single / none must be numerically identical given same seed
+    # wfbp / single / none must agree numerically given the same seed. Over
+    # a SHORT horizon the comparison is tight; a full epoch lets ULP-level
+    # rounding differences of the packed single-bucket reduction compound
+    # chaotically (exact per-application parity is pinned in
+    # tests/test_allreduce.py).
     losses = {}
     for policy in ("wfbp", "single", "none"):
-        cfg = _cfg(policy=policy)
+        cfg = _cfg(policy=policy, num_batches_per_epoch=5)
         t = Trainer(cfg, synthetic_data=True, profile_backward=False)
         m = t.train_epoch(0)
         losses[policy] = m["loss"]
     vals = list(losses.values())
-    assert max(vals) - min(vals) < 1e-4, losses
+    assert max(vals) - min(vals) < 1e-5, losses
 
 
 def test_evaluate_indivisible_val_set_counts_every_sample():
@@ -289,6 +293,9 @@ def test_evaluate_cli_offline(tmp_path, capsys):
         for l in capsys.readouterr().out.strip().splitlines()
         if l.startswith("{")
     ]
+    # last line is the running-best summary (reference evaluate.py:47-57)
+    assert lines[-1]["best"]["epoch"] == 0 and "top1" in lines[-1]["best"]
+    lines = lines[:-1]
     assert [m["epoch"] for m in lines] == [0]
     assert all("top1" in m for m in lines)
 
