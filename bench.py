@@ -319,8 +319,7 @@ def run_bench() -> dict:
             "('none'/'single') is the ceiling and merge scheduling can only "
             "add dispatch overhead; MG-WFBP's advantage needs real "
             "inter-chip communication (compare policies on a multi-chip "
-            "mesh). The production Trainer skips the reducer entirely at "
-            "world size 1 (reference single-path parity)."
+            "mesh)."
         )
     if mfu is not None and mfu > 1.0:
         # physically impossible: the measurement layer is broken; refuse to

@@ -189,7 +189,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--epoch", type=int, default=None,
                    help="epoch to evaluate (default: latest)")
     p.add_argument("--all-epochs", action="store_true",
-                   help="evaluate every saved epoch (one JSON line each); "
+                   help="evaluate every saved epoch (one JSON line each, "
+                        "then a final {\"best\": ...} summary line); "
                         "mutually exclusive with --epoch")
     p.add_argument("--average-dirs", dest="average_dirs", nargs="+",
                    default=None,

@@ -113,9 +113,37 @@ _REFERENCE_10GBE: Mapping[int, AlphaBeta] = {
 _TPU_ICI_DEFAULT = AlphaBeta(alpha=8e-06, beta=2.2e-11)
 _TPU_DCN_DEFAULT = AlphaBeta(alpha=2.5e-04, beta=4.0e-10)
 
+# 1GbE tables, split at the 1 MB payload boundary, plus the 10GbE variant
+# fit — measured constants of the reference's Ethernet clusters, used by its
+# sparse allgather model (reference utils.py:66-88, allgather_perf_model
+# :104-117 picks small vs large at 1 MB).
+_REFERENCE_1GBE_SMALL: Mapping[int, AlphaBeta] = {
+    2: AlphaBeta(1.6e-3, 1.0e-8),
+    4: AlphaBeta(2.7e-3, 1.3e-8),
+    8: AlphaBeta(4.0e-3, 1.5e-8),
+    16: AlphaBeta(1.7e-3, 1.7e-8),
+}
+
+_REFERENCE_1GBE_LARGE: Mapping[int, AlphaBeta] = {
+    2: AlphaBeta(4.4e-3, 5.8e-9),
+    4: AlphaBeta(5.6e-3, 7.4e-9),
+    8: AlphaBeta(7.68e-3, 8.2e-9),
+    16: AlphaBeta(2.1e-3, 1.7e-8),
+}
+
+_REFERENCE_10GBE_UTILS: Mapping[int, AlphaBeta] = {
+    2: AlphaBeta(1.5e-5, 5.7e-11),
+    4: AlphaBeta(3.6e-5, 1.1e-10),
+    8: AlphaBeta(8.5e-5, 1.4e-10),
+    16: AlphaBeta(1.4e-4, 2.0e-10),
+}
+
 _CONNECTIONS: Mapping[str, Mapping[int, AlphaBeta]] = {
     "56GbIB": _REFERENCE_56GBIB,
     "10GbE": _REFERENCE_10GBE,
+    "1GbE-small": _REFERENCE_1GBE_SMALL,
+    "1GbE-large": _REFERENCE_1GBE_LARGE,
+    "10GbE-utils": _REFERENCE_10GBE_UTILS,
 }
 
 
@@ -208,6 +236,51 @@ def sparse_allgather_time(
     return 2.0 * (
         alpha + beta * float(nelems) * nworkers * itemsize * density
     )
+
+
+def sparse_allgather_time_ethernet(
+    nelems: float, nworkers: int, density: float, itemsize: int = 4,
+) -> float:
+    """The reference's exact sparse-allgather predictor
+    (allgather_perf_model, utils.py:104-117): payload = n*P*itemsize*density,
+    constants from the 1GbE SMALL table below 1 MB and the LARGE table at or
+    above it, doubled for the (values, indices) pair."""
+    if nelems == 0:
+        return 0.0
+    size = float(nelems) * nworkers * itemsize * density
+    connection = "1GbE-large" if size >= 1024 * 1024 else "1GbE-small"
+    ab = lookup_alpha_beta(connection, nworkers)
+    return 2.0 * (ab.alpha + ab.beta * size)
+
+
+def choose_density(
+    nelems: float,
+    nworkers: int,
+    cost_model: "AlphaBeta | TwoLevelAlphaBeta",
+    candidates: Sequence[float] = (0.25, 0.05, 0.01, 0.001),
+    itemsize: int = 4,
+    topk_const: float = TOPK_MACHINE_CONST,
+) -> float:
+    """Density chooser for the compression seam (reference
+    `predict_density_with_size_and_computation`, utils.py:119-149 — mostly
+    commented out there, hardwired to 0.001; live here): return the density
+    whose predicted cost topk-select + sparse allgather is cheapest, or 1.0
+    when the dense all-reduce already wins (small tensors, where the doubled
+    allgather startup dominates any byte savings)."""
+    if nelems <= 0:
+        return 1.0
+    best_density = 1.0
+    best_t = cost_model.predict(float(nelems) * itemsize)
+    select = topk_time(nelems, topk_const)
+    for d in candidates:
+        # (values, indices) allgather: payload n*P*itemsize*d, doubled —
+        # the reference's allgather_perf_model shape, priced through
+        # whatever cost model (flat or two-level) describes the link
+        payload = float(nelems) * nworkers * itemsize * d
+        t = select + 2.0 * cost_model.predict(payload)
+        if t < best_t:
+            best_t, best_density = t, d
+    return best_density
 
 
 @dataclasses.dataclass(frozen=True)

@@ -386,11 +386,38 @@ class Trainer:
         )
         from mgwfbp_tpu.parallel.compression import make_compressor
 
-        compressor = make_compressor(cfg.compressor, cfg.density)
+        density = cfg.density
+        if cfg.compressor not in (None, "", "none") and density <= 0:
+            # --density 0 = auto: model-driven chooser (the reference's
+            # predict_density_with_size_and_computation is hardwired to
+            # 0.001, utils.py:119-149; ours prices topk + sparse allgather
+            # against the dense all-reduce with the active cost model)
+            from mgwfbp_tpu.parallel.costmodel import choose_density
+
+            n_elems = sum(
+                int(v.size)
+                for v in jax.tree_util.tree_leaves(self.state.params)
+            )
+            density = choose_density(
+                n_elems, self.data_size * self.seq_size, cost_model
+            )
+            self.log.info(
+                "auto density: %g for %d params over %d workers",
+                density, n_elems, self.data_size * self.seq_size,
+            )
+            if density >= 1.0:
+                # the model says dense wins: drop the compressor entirely
+                self.log.info(
+                    "auto density: dense all-reduce predicted cheaper than "
+                    "top-k + allgather on this link; compression disabled"
+                )
+                density = 1.0
+                cfg = dataclasses.replace(cfg, compressor="none")
+        compressor = make_compressor(cfg.compressor, density)
         if compressor is not None:
             self.log.info(
                 "gradient compression: %s density=%g",
-                cfg.compressor, cfg.density,
+                cfg.compressor, density,
             )
         return make_merged_allreduce(
             self.state.params,

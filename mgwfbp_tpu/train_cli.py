@@ -77,7 +77,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "topk"],
                    help="gradient compressor (reference --compressor)")
     p.add_argument("--density", type=float, default=None,
-                   help="kept-fraction for sparsifying compressors")
+                   help="kept-fraction for sparsifying compressors; 0 = "
+                        "auto (cost-model chooser, may fall back to dense)")
     p.add_argument("--comm-op", dest="comm_op", default=None,
                    choices=["all_reduce", "rs_ag"],
                    help="bucket collective: monolithic all-reduce or "

@@ -100,3 +100,47 @@ def test_init_distributed_requires_num_processes_when_explicit():
 
     with pytest.raises(ValueError, match="num_processes"):
         init_distributed(coordinator_address="host0:1234", process_id=0)
+
+
+def test_reference_ethernet_tables_and_allgather_model():
+    """The reference's 1GbE small/large and utils-10GbE tables carried as
+    data (utils.py:66-88), and its exact sparse-allgather predictor
+    (utils.py:104-117): small table under 1 MB payload, large at/above,
+    doubled for the (values, indices) pair."""
+    from mgwfbp_tpu.parallel.costmodel import (
+        lookup_alpha_beta, sparse_allgather_time_ethernet,
+    )
+
+    assert lookup_alpha_beta("1GbE-small", 8).alpha == pytest.approx(4.0e-3)
+    assert lookup_alpha_beta("1GbE-large", 16).beta == pytest.approx(1.7e-8)
+    assert lookup_alpha_beta("10GbE-utils", 4).alpha == pytest.approx(3.6e-5)
+
+    # hand computation against the reference formula, P=8 density=0.001:
+    # n=1e6 -> size = 1e6*8*4*0.001 = 32000 B < 1MB -> small table
+    n, p, d = 1e6, 8, 0.001
+    size = n * p * 4 * d
+    want = 2 * (4.0e-3 + 1.5e-8 * size)
+    assert sparse_allgather_time_ethernet(n, p, d) == pytest.approx(want)
+    # n=1e8 -> size = 3.2e6 B >= 1MB -> large table
+    n = 1e8
+    size = n * p * 4 * d
+    want = 2 * (7.68e-3 + 8.2e-9 * size)
+    assert sparse_allgather_time_ethernet(n, p, d) == pytest.approx(want)
+    assert sparse_allgather_time_ethernet(0, p, d) == 0.0
+
+
+def test_choose_density_dense_for_small_sparse_for_huge():
+    """Live density chooser (reference predict_density_..., utils.py:119-149,
+    hardwired to 0.001 there): small tensors stay dense (doubled allgather
+    startup dominates), huge beta-bound tensors sparsify."""
+    from mgwfbp_tpu.parallel.costmodel import AlphaBeta, choose_density
+
+    slow = AlphaBeta(alpha=1e-3, beta=1e-8)  # 1GbE-class link
+    assert choose_density(1_000, 16, slow) == 1.0  # alpha-dominated: dense
+    d = choose_density(5e8, 16, slow)  # 2 GB dense payload on 1GbE: sparsify
+    assert d < 1.0
+    # on a fast link the top-k select cost alone exceeds the dense
+    # all-reduce, so dense must win even for huge tensors
+    fast = AlphaBeta(alpha=1e-5, beta=1e-10)
+    assert choose_density(5e8, 16, fast) == 1.0
+    assert choose_density(0, 16, slow) == 1.0
