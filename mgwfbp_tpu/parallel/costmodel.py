@@ -250,7 +250,9 @@ def sparse_allgather_time_ethernet(
     size = float(nelems) * nworkers * itemsize * density
     connection = "1GbE-large" if size >= 1024 * 1024 else "1GbE-small"
     ab = lookup_alpha_beta(connection, nworkers)
-    return 2.0 * (ab.alpha + ab.beta * size)
+    return sparse_allgather_time(
+        ab.alpha, ab.beta, nelems, nworkers, density, itemsize
+    )
 
 
 def choose_density(

@@ -817,6 +817,11 @@ class Trainer:
             metrics = {"train": train_metrics}
             if self.writer is not None:
                 self.writer.add_scalars("epoch", train_metrics, epoch)
+                self.writer.add_scalar(
+                    "epoch/lr",
+                    float(self.epoch_schedule(jnp.asarray(float(epoch)))),
+                    epoch,
+                )
             if (epoch + 1) % cfg.eval_every_epochs == 0:
                 eval_metrics = self.evaluate()
                 metrics["eval"] = eval_metrics

@@ -187,3 +187,28 @@ def test_registry_dataset_override():
     assert meta.num_classes == 10
     model, meta = zoo.create_model("vgg16", num_classes=100)
     assert meta.num_classes == 100
+
+
+def test_parameter_counts_match_canonical():
+    """Parameter counts pinned to the canonical architecture sizes — a
+    wrong block layout / channel width / head count moves these immediately
+    (reference models/: CifarResNet, torchvision resnet50/alexnet/densenet,
+    googlenet-with-aux, PTB 2x1500 LSTM)."""
+    import jax
+
+    expected = {
+        "resnet20": 272_474,
+        "resnet56": 855_770,
+        "resnet110": 1_730_714,
+        "resnet50": 25_557_032,
+        "densenet121": 7_978_856,
+        "googlenet": 13_385_816,
+        "alexnet": 61_100_840,
+        "lstm": 66_022_000,
+    }
+    for name, want in expected.items():
+        model, meta = zoo.create_model(name)
+        x = jnp.zeros((1,) + tuple(meta.input_shape), meta.input_dtype)
+        v = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+        n = sum(int(a.size) for a in jax.tree_util.tree_leaves(v["params"]))
+        assert n == want, f"{name}: {n} != {want}"
