@@ -101,6 +101,42 @@ def _rs_ag_allreduce(buf: jax.Array, axes, mean: bool) -> jax.Array:
     return full[:n] if pad else full
 
 
+def _check_hier_axes(comm_op: str, axis_name) -> None:
+    if comm_op == "hier" and (
+        isinstance(axis_name, str) or len(axis_name) != 2
+    ):
+        raise ValueError(
+            "comm_op='hier' needs axis_name=(inner_ici_axis, outer_dcn_axis)"
+        )
+
+
+def _hierarchical_allreduce(
+    buf: jax.Array, inner_axis: str, outer_axis: str, mean: bool
+) -> jax.Array:
+    """Two-level bucket all-reduce for multi-slice meshes — the lowering
+    whose cost `costmodel.TwoLevelAlphaBeta` models: reduce-scatter over the
+    fast INNER axis (ICI within a slice), all-reduce the resulting shard
+    over the slow OUTER axis (DCN across slices), then all-gather back over
+    the inner axis. The full payload rides ICI; DCN carries only
+    1/inner_size of it — the standard pod-slice hierarchy a flat psum over
+    both axes leaves to XLA's discretion, made explicit so the solver's
+    two-level cost predictions describe the actual wire traffic."""
+    n = buf.shape[0]
+    inner = int(lax.axis_size(inner_axis))
+    world = inner * int(lax.axis_size(outer_axis))
+    pad = (-n) % inner
+    if pad:
+        buf = jnp.pad(buf, (0, pad))
+    shard = lax.psum_scatter(
+        buf, inner_axis, scatter_dimension=0, tiled=True
+    )
+    shard = lax.psum(shard, outer_axis)
+    if mean:
+        shard = shard / world
+    full = lax.all_gather(shard, inner_axis, axis=0, tiled=True)
+    return full[:n] if pad else full
+
+
 def merged_psum(
     tree: Any,
     layout: BucketLayout,
@@ -142,15 +178,17 @@ def merged_psum(
     partitioner on at least the CPU backend — verified empirically; the
     combiner then re-merges everything.)
     """
-    if comm_op not in ("all_reduce", "rs_ag"):
+    if comm_op not in ("all_reduce", "rs_ag", "hier"):
         raise ValueError(
-            f"unknown comm_op {comm_op!r}; expected 'all_reduce' or 'rs_ag'"
+            f"unknown comm_op {comm_op!r}; expected 'all_reduce', 'rs_ag' "
+            "or 'hier'"
         )
     if compressor is not None and comm_op != "all_reduce":
         raise ValueError(
-            "comm_op='rs_ag' cannot combine with a sparsifying compressor "
-            "(the compressor replaces the bucket collective entirely)"
+            f"comm_op={comm_op!r} cannot combine with a sparsifying "
+            "compressor (the compressor replaces the bucket collective)"
         )
+    _check_hier_axes(comm_op, axis_name)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arr = [leaves[j] for j in perm]
     shapes = [l.shape for l in arr]
@@ -173,6 +211,8 @@ def merged_psum(
             buf = compressor.allreduce(buf, axes, mean)
         elif comm_op == "rs_ag":
             buf = _rs_ag_allreduce(buf, axes, mean)
+        elif comm_op == "hier":
+            buf = _hierarchical_allreduce(buf, axes[0], axes[1], mean)
         else:
             buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
         token = buf[0]
@@ -203,7 +243,8 @@ class MergedAllreduce:
     comm_dtype: Optional[Any] = None
     compressor: Optional[Any] = None
     sequential: bool = True
-    comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR decomposition)
+    comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR decomposition) |
+    # hier (two-level ICI+DCN, needs axis_name=(ici, dcn) — API-level only)
 
     def __call__(self, grads: Any) -> Any:
         return merged_psum(
@@ -249,6 +290,8 @@ def make_merged_allreduce(
         all_names = [jax.tree_util.keystr(kp) for kp, _ in paths]
     else:
         all_names = list(names)
+    # fail at construction, not at first traced call
+    _check_hier_axes(comm_op, axis_name)
     p = arrival_order(n, perm, names=all_names)
     arr = [leaves[j] for j in p]
     names_arr = [all_names[j] for j in p]

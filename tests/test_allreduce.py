@@ -173,3 +173,58 @@ def test_dtype_split_updates_schedule_predictions():
     )
     assert mar.schedule.num_groups == mar.layout.num_groups == 2
     assert mar.schedule.predicted_comm_time == 2.0  # one alpha per real group
+
+
+def test_hierarchical_allreduce_matches_plain_pmean():
+    """comm_op='hier' (reduce-scatter on the inner/ICI axis, all-reduce the
+    shard on the outer/DCN axis, all-gather back — the lowering
+    TwoLevelAlphaBeta prices) must be numerically identical to a flat pmean
+    over both axes, including non-divisible buckets (pad path)."""
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh2 = Mesh(devs, ("ici", "dcn"))
+    rng = np.random.RandomState(1)
+    # bias sizes indivisible by the 4-wide inner axis exercise the padding
+    tree = {
+        "w": jnp.asarray(rng.randn(6, 5), jnp.float32),
+        "b": jnp.asarray(rng.randn(7), jnp.float32),
+    }
+    mar = make_merged_allreduce(
+        tree, axis_name=("ici", "dcn"), policy="wfbp", comm_op="hier",
+    )
+
+    @functools.partial(
+        shard_map, mesh=mesh2,
+        in_specs=(P(("ici", "dcn")),), out_specs=P(), check_vma=False,
+    )
+    def merged(shards):
+        return mar(jax.tree_util.tree_map(lambda s: s.mean(0), shards))
+
+    @functools.partial(
+        shard_map, mesh=mesh2,
+        in_specs=(P(("ici", "dcn")),), out_specs=P(), check_vma=False,
+    )
+    def plain(shards):
+        return jax.lax.pmean(
+            jax.tree_util.tree_map(lambda s: s.mean(0), shards),
+            ("ici", "dcn"),
+        )
+
+    batched = jax.tree_util.tree_map(
+        lambda a: jnp.stack([a + i for i in range(8)]), tree
+    )
+    got = merged(batched)
+    want = plain(batched)
+    for k in tree:
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_hier_requires_two_axes():
+    tree = {"w": jnp.ones((4,))}
+    with pytest.raises(ValueError, match="hier"):
+        make_merged_allreduce(
+            tree, axis_name=DATA_AXIS, policy="wfbp", comm_op="hier"
+        )
