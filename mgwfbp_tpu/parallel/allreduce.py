@@ -78,27 +78,42 @@ def arrival_order(
     return list(reversed(range(num_leaves)))
 
 
+def _scatter_mid_gather(
+    buf: jax.Array, scatter_axes, mean_div: int, mid=None
+) -> jax.Array:
+    """Shared frame of the decomposed bucket all-reduces: pad the bucket to
+    scatter-axis divisibility, reduce-scatter over `scatter_axes`, apply an
+    optional `mid` transform to the shard, divide by `mean_div` (1 = sum
+    semantics), all-gather back, trim the pad."""
+    n = buf.shape[0]
+    # static extents: mesh axis sizes are known at trace time
+    parts = 1
+    for a in scatter_axes:
+        parts *= int(lax.axis_size(a))
+    pad = (-n) % parts
+    if pad:
+        buf = jnp.pad(buf, (0, pad))
+    shard = lax.psum_scatter(
+        buf, scatter_axes, scatter_dimension=0, tiled=True
+    )
+    if mid is not None:
+        shard = mid(shard)
+    if mean_div != 1:
+        shard = shard / mean_div
+    full = lax.all_gather(shard, scatter_axes, axis=0, tiled=True)
+    return full[:n] if pad else full
+
+
 def _rs_ag_allreduce(buf: jax.Array, axes, mean: bool) -> jax.Array:
     """Bucket all-reduce as reduce-scatter + all-gather (the DeAR-style
     decomposition, arXiv:2302.12445): each phase moves half a ring
     all-reduce's bytes, and XLA may overlap the all-gather of group k with
     other work more aggressively than a monolithic all-reduce. Numerically
-    identical to pmean/psum; buckets are padded to axis-size divisibility
-    for the scatter and trimmed after the gather."""
-    n = buf.shape[0]
-    # static world size: mesh axis extents are known at trace time
+    identical to pmean/psum."""
     world = 1
     for a in axes:
-        world *= lax.axis_size(a)
-    world = int(world)
-    pad = (-n) % world
-    if pad:
-        buf = jnp.pad(buf, (0, pad))
-    shard = lax.psum_scatter(buf, axes, scatter_dimension=0, tiled=True)
-    if mean:
-        shard = shard / world
-    full = lax.all_gather(shard, axes, axis=0, tiled=True)
-    return full[:n] if pad else full
+        world *= int(lax.axis_size(a))
+    return _scatter_mid_gather(buf, axes, world if mean else 1)
 
 
 def _check_hier_axes(comm_op: str, axis_name) -> None:
@@ -121,20 +136,13 @@ def _hierarchical_allreduce(
     1/inner_size of it — the standard pod-slice hierarchy a flat psum over
     both axes leaves to XLA's discretion, made explicit so the solver's
     two-level cost predictions describe the actual wire traffic."""
-    n = buf.shape[0]
-    inner = int(lax.axis_size(inner_axis))
-    world = inner * int(lax.axis_size(outer_axis))
-    pad = (-n) % inner
-    if pad:
-        buf = jnp.pad(buf, (0, pad))
-    shard = lax.psum_scatter(
-        buf, inner_axis, scatter_dimension=0, tiled=True
+    world = int(lax.axis_size(inner_axis)) * int(lax.axis_size(outer_axis))
+    return _scatter_mid_gather(
+        buf,
+        (inner_axis,),
+        world if mean else 1,
+        mid=lambda shard: lax.psum(shard, outer_axis),
     )
-    shard = lax.psum(shard, outer_axis)
-    if mean:
-        shard = shard / world
-    full = lax.all_gather(shard, inner_axis, axis=0, tiled=True)
-    return full[:n] if pad else full
 
 
 def merged_psum(

@@ -261,14 +261,19 @@ def make_train_step(
             "sequence parallelism is for windowed lm models; BPTT carry "
             "models shard only the data axis"
         )
-    red_axes = (
-        (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    # axis_name may be a TUPLE of mesh axes jointly forming the data
+    # dimension — the multi-slice case (e.g. ("ici", "dcn")) where the
+    # reducer uses the hierarchical two-level lowering (comm_op='hier')
+    data_axes = (
+        (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     )
+    red_axes = data_axes if seq_axis is None else data_axes + (seq_axis,)
 
     def per_device(state: TrainState, batch, carry):
         step_rng = jax.random.fold_in(state.rng, state.step)
         # decorrelate dropout across data-parallel members
-        step_rng = jax.random.fold_in(step_rng, lax.axis_index(axis_name))
+        for ax in data_axes:
+            step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
         if seq_axis is not None:
             # ...and across sequence shards (different token slices)
             step_rng = jax.random.fold_in(step_rng, lax.axis_index(seq_axis))
@@ -347,17 +352,19 @@ def make_train_step(
         )
         return new_state, metrics, new_carry
 
+    # P treats a one-element tuple of axis names like the bare name
+    batch_axes = data_axes
     if seq_axis is None:
-        batch_spec = P(None, axis_name)  # (nsteps, batch, ...)
+        batch_spec = P(None, batch_axes)  # (nsteps, batch, ...)
     else:
         # (nsteps, batch, time): batch over data, time over seq
-        batch_spec = P(None, axis_name, seq_axis)
+        batch_spec = P(None, batch_axes, seq_axis)
     if has_carry:
         fn = jax.shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(), batch_spec, P(axis_name)),
-            out_specs=(P(), P(), P(axis_name)),
+            in_specs=(P(), batch_spec, P(batch_axes)),
+            out_specs=(P(), P(), P(batch_axes)),
             check_vma=False,
         )
 
@@ -411,7 +418,11 @@ def make_eval_step(
     per-shard token-mean losses and the P_seq-times-counted `count` divide
     back to the true per-sample mean.
     """
-    red_axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    # tuple axis_name = multi-slice data dimension, mirroring make_train_step
+    data_axes = (
+        (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    )
+    red_axes = data_axes if seq_axis is None else data_axes + (seq_axis,)
     if seq_axis is not None and meta.has_carry:
         raise ValueError("seq-sharded eval requires a carry-free lm model")
 
@@ -488,8 +499,8 @@ def make_eval_step(
         fn = jax.shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(), P(axis_name), P(axis_name)),
-            out_specs=(P(), P(axis_name)),
+            in_specs=(P(), P(data_axes), P(data_axes)),
+            out_specs=(P(), P(data_axes)),
             check_vma=False,
         )
         return jax.jit(fn)
@@ -502,7 +513,7 @@ def make_eval_step(
         fn = jax.shard_map(
             per_device_nocarry,
             mesh=mesh,
-            in_specs=(P(), P(axis_name)),
+            in_specs=(P(), P(data_axes)),
             out_specs=P(),
             check_vma=False,
         )
@@ -518,9 +529,9 @@ def make_eval_step(
         if key not in cache:
             spec = {
                 k: (
-                    P(axis_name)
+                    P(data_axes)
                     if batch[k].ndim == 1
-                    else P(axis_name, seq_axis)
+                    else P(data_axes, seq_axis)
                 )
                 for k in batch
             }

@@ -245,3 +245,87 @@ def test_schedules_shapes_and_values():
     assert float(v(25.0)) == pytest.approx(0.05)
     c = resolve("cosine", 0.1, max_epochs=90)
     assert float(c(90.0)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_train_step_multislice_hier_matches_flat_mesh():
+    """Data parallelism over a TUPLE of mesh axes (the multi-slice case):
+    one train step on an (ici=2, dcn=4) mesh with the hierarchical bucket
+    lowering must match the same step on the flat 8-device data mesh."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.optim import make_optimizer
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+    from mgwfbp_tpu.parallel.costmodel import (
+        AlphaBeta, TwoLevelAlphaBeta,
+    )
+    from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    model, meta = zoo.create_model("lenet", dataset="mnist")
+    tx, _ = make_optimizer(0.01, momentum=0.9, weight_decay=1e-4,
+                           lr_schedule="const", dataset="mnist",
+                           num_batches_per_epoch=1)
+
+    def one_step(mesh, axis_name, reducer):
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, jnp.zeros((1, 28, 28, 1)), tx
+        )
+        step = make_train_step(
+            model, meta, tx, mesh, reducer, axis_name=axis_name, donate=False
+        )
+        rs = np.random.RandomState(0)
+        batch = {
+            "x": jnp.asarray(rs.randn(1, 16, 28, 28, 1), jnp.float32),
+            "y": jnp.asarray(rs.randint(0, 10, (1, 16)), jnp.int32),
+        }
+        new_state, m = step(state, batch)
+        return float(m["loss"]), new_state
+
+    cm2 = TwoLevelAlphaBeta(
+        ici=AlphaBeta(1e-5, 1e-10), dcn=AlphaBeta(1e-3, 1e-9),
+        ici_size=2, dcn_size=4,
+    )
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh2 = Mesh(devs, ("ici", "dcn"))
+    params = zoo.create_model("lenet", dataset="mnist")[0].init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 28, 28, 1)),
+        train=False,
+    )["params"]
+    red2 = make_merged_allreduce(
+        params, axis_name=("ici", "dcn"), policy="mgwfbp",
+        tb=[1e-4] * len(jax.tree_util.tree_leaves(params)),
+        cost_model=cm2, comm_op="hier",
+    )
+    loss_hier, st2 = one_step(mesh2, ("ici", "dcn"), red2)
+
+    flat = make_mesh(MeshSpec(data=8))
+    red1 = make_merged_allreduce(
+        params, axis_name="data", policy="wfbp",
+    )
+    loss_flat, st1 = one_step(flat, "data", red1)
+    assert loss_hier == pytest.approx(loss_flat, abs=1e-5)
+    p2 = jax.tree_util.tree_leaves(st2.params)
+    p1 = jax.tree_util.tree_leaves(st1.params)
+    for a, b in zip(p2, p1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
+
+
+def test_eval_step_multislice_tuple_axes():
+    """make_eval_step mirrors the train step's tuple data-axis support."""
+    from jax.sharding import Mesh
+
+    model, meta, tx, state, batch = _lenet_setup()
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh2 = Mesh(devs, ("ici", "dcn"))
+    ev = make_eval_step(model, meta, mesh2, axis_name=("ici", "dcn"))
+    metrics = ev(state, {"x": batch["x"][0], "y": batch["y"][0]})
+    assert float(metrics["count"]) == batch["x"].shape[1]
+    flat = make_eval_step(model, meta, make_mesh(MeshSpec(data=8)))
+    want = flat(state, {"x": batch["x"][0], "y": batch["y"][0]})
+    assert float(metrics["top1"]) == pytest.approx(float(want["top1"]))
+    assert float(metrics["loss"]) == pytest.approx(
+        float(want["loss"]), rel=1e-6
+    )
