@@ -29,6 +29,9 @@ class TrainConfig:
     # distributed
     nworkers: int = 1
     seq_parallel: int = 1  # sequence-parallel mesh extent (TPU extension)
+    dcn_slices: int = 1  # multi-slice pod: outer data-parallel level whose
+    # collectives cross the data-center network (two-level cost model;
+    # --comm-op hier lowers the hierarchy explicitly)
     num_steps: Optional[int] = None  # LM window length override (default 35;
     # seq-parallel transformers need num_steps % seq_parallel == 0)
 

@@ -7,7 +7,12 @@ chips (subsuming the reference's `nn.DataParallel` intra-node path,
 dl_trainer.py:193-198).
 
 Axes:
-  data  — data parallelism (the reference's entire parallelism model)
+  dcn   — slice axis of a multi-slice pod (data-parallel OUTER level; only
+          present when MeshSpec.dcn > 1). Collectives crossing it ride the
+          data-center network, which `costmodel.TwoLevelAlphaBeta` prices
+          and `comm_op='hier'` lowers for explicitly.
+  data  — data parallelism (the reference's entire parallelism model);
+          within a slice, rides ICI.
   seq   — sequence/context parallelism axis; consumed by
           `parallel.ringattn` (ring attention over ppermute). The reference
           has no sequence parallelism (SURVEY.md §5 "Long-context") — this
@@ -26,15 +31,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+DCN_AXIS = "dcn"
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     data: int = -1  # -1: all remaining devices
     seq: int = 1
-
-    def axis_names(self) -> tuple[str, ...]:
-        return (DATA_AXIS, SEQ_AXIS)
+    dcn: int = 1  # slices of a multi-slice pod (outer data-parallel level)
 
 
 def init_distributed(
@@ -79,19 +83,28 @@ def make_mesh(
     spec: MeshSpec = MeshSpec(),
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, seq) mesh over the available devices.
+    """Build a (data, seq) — or, multi-slice, (dcn, data, seq) — mesh over
+    the available devices.
 
-    The device order follows jax.devices(), which keeps ICI neighbours adjacent
-    on TPU so the data-axis ring rides ICI links.
+    The device order follows jax.devices(), which keeps ICI neighbours
+    adjacent on TPU so the data-axis ring rides ICI links; on a multi-slice
+    pod jax enumerates slice-by-slice, so the LEADING dcn dimension puts
+    each slice's chips contiguously on the inner axes.
     """
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
     seq = max(spec.seq, 1)
-    if n % seq != 0:
-        raise ValueError(f"{n} devices not divisible by seq={seq}")
-    data = spec.data if spec.data > 0 else n // seq
-    if data * seq != n:
-        raise ValueError(f"mesh {data}x{seq} != {n} devices")
+    dcn = max(spec.dcn, 1)
+    if n % (seq * dcn) != 0:
+        raise ValueError(
+            f"{n} devices not divisible by seq={seq} x dcn={dcn}"
+        )
+    data = spec.data if spec.data > 0 else n // (seq * dcn)
+    if data * seq * dcn != n:
+        raise ValueError(f"mesh {dcn}x{data}x{seq} != {n} devices")
+    if dcn > 1:
+        arr = np.asarray(devs).reshape(dcn, data, seq)
+        return Mesh(arr, (DCN_AXIS, DATA_AXIS, SEQ_AXIS))
     arr = np.asarray(devs).reshape(data, seq)
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
 

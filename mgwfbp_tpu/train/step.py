@@ -353,18 +353,17 @@ def make_train_step(
         return new_state, metrics, new_carry
 
     # P treats a one-element tuple of axis names like the bare name
-    batch_axes = data_axes
     if seq_axis is None:
-        batch_spec = P(None, batch_axes)  # (nsteps, batch, ...)
+        batch_spec = P(None, data_axes)  # (nsteps, batch, ...)
     else:
         # (nsteps, batch, time): batch over data, time over seq
-        batch_spec = P(None, batch_axes, seq_axis)
+        batch_spec = P(None, data_axes, seq_axis)
     if has_carry:
         fn = jax.shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(), batch_spec, P(batch_axes)),
-            out_specs=(P(), P(), P(batch_axes)),
+            in_specs=(P(), batch_spec, P(data_axes)),
+            out_specs=(P(), P(), P(data_axes)),
             check_vma=False,
         )
 

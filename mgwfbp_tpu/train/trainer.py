@@ -55,9 +55,22 @@ class Trainer:
     ):
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(
-            MeshSpec(data=-1, seq=config.seq_parallel)
+            MeshSpec(
+                data=-1, seq=config.seq_parallel, dcn=config.dcn_slices,
+            )
         )
-        self.data_size = self.mesh.shape[DATA_AXIS]
+        from mgwfbp_tpu.parallel.mesh import DCN_AXIS
+
+        self.dcn_size = self.mesh.shape.get(DCN_AXIS, 1)
+        self.ici_size = self.mesh.shape[DATA_AXIS]
+        # total data-parallel membership (weak scaling, cost-model world
+        # size, eval quantum): inner ICI extent x outer DCN slices
+        self.data_size = self.ici_size * self.dcn_size
+        # data-dimension mesh axes, ALWAYS a tuple, inner first (the hier
+        # lowering convention); every consumer takes it verbatim
+        self.data_axes = (
+            (DATA_AXIS, DCN_AXIS) if self.dcn_size > 1 else (DATA_AXIS,)
+        )
         # reflect the actual worker count into the config BEFORE anything
         # consumes config.tag(): run tags / log dirs / checkpoint dirs must
         # all distinguish 1-device from N-device runs, consistently
@@ -202,12 +215,13 @@ class Trainer:
         )
         self.train_step = make_train_step(
             step_model, self.meta, self.tx, self.mesh, self.reducer,
-            nsteps_update=self.config.nsteps_update, seq_axis=self.seq_axis,
+            nsteps_update=self.config.nsteps_update,
+            axis_name=self.data_axes, seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
         )
         self.eval_step = make_eval_step(
-            step_model, self.meta, self.mesh, seq_axis=self.seq_axis,
-            compute_dtype=self.compute_dtype,
+            step_model, self.meta, self.mesh, axis_name=self.data_axes,
+            seq_axis=self.seq_axis, compute_dtype=self.compute_dtype,
         )
 
     def _build_run_sinks(self) -> None:
@@ -273,6 +287,11 @@ class Trainer:
         """
         if nworkers == self.data_size:
             return
+        if self.dcn_size > 1:
+            raise NotImplementedError(
+                "update_nworker on a multi-slice (dcn) mesh is not "
+                "supported; relaunch with new --dcn-slices instead"
+            )
         if jax.process_count() > 1:
             # Cross-host elastic resize needs a coordinated device subset on
             # every host plus loader re-ranking — out of scope, exactly as in
@@ -356,6 +375,16 @@ class Trainer:
 
     def _build_reducer(self, profile_backward: bool):
         cfg = self.config
+        if cfg.comm_op == "hier" and (
+            self.dcn_size <= 1 or self.seq_axis is not None
+        ):
+            # fail fast: this needs only config + mesh shape, so don't burn
+            # the offline backward benchmark on a config error
+            raise ValueError(
+                "--comm-op hier needs a multi-slice mesh "
+                "(--dcn-slices > 1) and no sequence parallelism; "
+                f"got dcn={self.dcn_size}, seq={self.seq_size}"
+            )
         if cfg.policy in ("none", "xla"):
             # the ORIGINAL_HOROVOD-style oracle: one pmean per grad leaf
             # fused at XLA's discretion (reference settings.py:34 A/B switch)
@@ -372,8 +401,19 @@ class Trainer:
             return None
         if cfg.comm_profile:
             cost_model = load_profile(cfg.comm_profile)
+        elif self.dcn_size > 1:
+            # multi-slice: two-level model — ICI within a slice, DCN across
+            from mgwfbp_tpu.parallel.costmodel import TwoLevelAlphaBeta
+
+            cost_model = TwoLevelAlphaBeta(
+                ici=lookup_alpha_beta("ici", self.ici_size),
+                dcn=lookup_alpha_beta("dcn", self.dcn_size),
+                ici_size=self.ici_size,
+                dcn_size=self.dcn_size,
+            )
         else:
             cost_model = lookup_alpha_beta(cfg.connection, self.data_size)
+        self.cost_model = cost_model  # introspection (logs, tests)
         tb = None
         if cfg.policy == "mgwfbp" and profile_backward:
             if self._tb_cache is None:
@@ -419,15 +459,15 @@ class Trainer:
                 "gradient compression: %s density=%g",
                 cfg.compressor, density,
             )
+        # with sequence parallelism every (data, seq) member computes a
+        # partial gradient; the merged buckets reduce over ALL those axes
+        # (and over dcn on a multi-slice mesh)
+        axes = self.data_axes
+        if self.seq_axis is not None:
+            axes = axes + (self.seq_axis,)
         return make_merged_allreduce(
             self.state.params,
-            # with sequence parallelism every (data, seq) member computes a
-            # partial gradient; the merged buckets reduce over both axes
-            axis_name=(
-                DATA_AXIS
-                if self.seq_axis is None
-                else (DATA_AXIS, self.seq_axis)
-            ),
+            axis_name=axes,
             policy=cfg.policy,
             tb=tb,
             cost_model=cost_model,
@@ -543,7 +583,7 @@ class Trainer:
 
         def put(a):
             spec = [None] * a.ndim
-            spec[axes] = DATA_AXIS
+            spec[axes] = self.data_axes  # str, or (data, dcn) multi-slice
             sharding = NamedSharding(self.mesh, PartitionSpec(*spec))
             return jax.make_array_from_process_local_data(
                 sharding, np.asarray(a)

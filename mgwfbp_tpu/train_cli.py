@@ -80,9 +80,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kept-fraction for sparsifying compressors; 0 = "
                         "auto (cost-model chooser, may fall back to dense)")
     p.add_argument("--comm-op", dest="comm_op", default=None,
-                   choices=["all_reduce", "rs_ag"],
-                   help="bucket collective: monolithic all-reduce or "
-                        "reduce-scatter + all-gather (DeAR-style)")
+                   choices=["all_reduce", "rs_ag", "hier"],
+                   help="bucket collective: monolithic all-reduce, "
+                        "reduce-scatter + all-gather (DeAR-style), or the "
+                        "hierarchical two-level ICI+DCN lowering (requires "
+                        "--dcn-slices > 1)")
+    p.add_argument("--dcn-slices", dest="dcn_slices", type=int, default=None,
+                   help="slices of a multi-slice pod: adds an outer "
+                        "data-parallel mesh axis whose collectives cross "
+                        "DCN (two-level cost model)")
     p.add_argument("--no-profile-backward", action="store_true",
                    help="skip the offline backward benchmark (size prior)")
     p.add_argument("--epochs", type=int, default=None,
@@ -105,7 +111,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "comm_profile", "dtype", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
             "num_steps", "num_batches_per_epoch", "compressor", "density",
-            "comm_op",
+            "comm_op", "dcn_slices",
         )
         if getattr(args, k, None) is not None
     }

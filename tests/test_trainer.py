@@ -518,3 +518,39 @@ def test_auto_density():
         assert 0.0 < comp.density < 1.0
     m = t.train_epoch(0)
     assert np.isfinite(m["loss"])
+
+
+def test_trainer_multislice_dcn():
+    """--dcn-slices 2 on 8 devices: a (dcn=2, data=4) mesh, two-level cost
+    model, mgwfbp schedule, and (with --comm-op hier) the explicit
+    hierarchical lowering. Same seed + same global batch as the flat 8-way
+    mesh must give the same loss."""
+    # lenet: dropout-free, so per-device rng folding (which legitimately
+    # differs between mesh layouts) cannot move the loss
+    flat = _cfg("lenet", num_batches_per_epoch=3, batch_size=8)
+    t_flat = Trainer(flat, synthetic_data=True, profile_backward=False)
+    m_flat = t_flat.train_epoch(0)
+
+    for comm_op in ("all_reduce", "hier"):
+        cfg = _cfg("lenet", num_batches_per_epoch=3, batch_size=8,
+                   dcn_slices=2, comm_op=comm_op)
+        t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+        assert t.dcn_size == 2 and t.ici_size == 4 and t.data_size == 8
+        assert t.config.nworkers == 8
+        assert t.reducer is not None
+        # the two-level ICI+DCN model must drive the solver on a
+        # multi-slice mesh
+        from mgwfbp_tpu.parallel.costmodel import TwoLevelAlphaBeta
+
+        assert isinstance(t.cost_model, TwoLevelAlphaBeta)
+        assert t.cost_model.ici_size == 4 and t.cost_model.dcn_size == 2
+        assert t.reducer.schedule.num_groups >= 1
+        assert t.reducer.comm_op == comm_op
+        m = t.train_epoch(0)
+        assert m["loss"] == pytest.approx(m_flat["loss"], abs=1e-5), comm_op
+
+
+def test_trainer_hier_requires_multislice():
+    cfg = _cfg(comm_op="hier")
+    with pytest.raises(ValueError, match="dcn-slices"):
+        Trainer(cfg, synthetic_data=True, profile_backward=False)
