@@ -45,9 +45,7 @@ class TrainConfig:
     compressor: str = "none"  # none | topk
     density: float = 1.0  # kept fraction for sparsifying compressors
     comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR-style RS+AG per
-    # bucket); a third lowering, 'hier' (two-level ICI+DCN), exists at the
-    # make_merged_allreduce API level — it needs an (ici, dcn) mesh axis
-    # pair the single-slice trainer mesh does not have
+    # bucket) | hier (two-level ICI+DCN lowering; needs dcn_slices > 1)
 
     # numerics
     dtype: str = "float32"  # param/compute dtype

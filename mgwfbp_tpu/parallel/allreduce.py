@@ -252,7 +252,8 @@ class MergedAllreduce:
     compressor: Optional[Any] = None
     sequential: bool = True
     comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR decomposition) |
-    # hier (two-level ICI+DCN, needs axis_name=(ici, dcn) — API-level only)
+    # hier (two-level ICI+DCN; needs axis_name=(inner_ici, outer_dcn) —
+    # the trainer wires it via --dcn-slices + --comm-op hier)
 
     def __call__(self, grads: Any) -> Any:
         return merged_psum(
