@@ -323,6 +323,7 @@ class Trainer:
             devices=jax.devices()[:n_devices],
         )
         self.data_size = nworkers
+        self.ici_size = nworkers  # single-slice resize (dcn guarded above)
         self.config.nworkers = nworkers
         self.process_batch = self.config.batch_size * nworkers
         # re-replicate state onto the new mesh (the reference's post-resize
