@@ -49,12 +49,13 @@ def ids_to_text(ids) -> str:
 
 def log_spectrogram(signal: np.ndarray, sample_rate: int = SAMPLE_RATE) -> np.ndarray:
     """STFT log-magnitude, per-utterance normalized — the deepspeech.pytorch
-    SpectrogramDataset recipe (hann window, n_fft=320, hop=160)."""
+    SpectrogramDataset recipe with the reference's audio_conf (HAMMING
+    window, reference models/lstman4.py:8-19; n_fft=320, hop=160)."""
     n_fft = int(sample_rate * WINDOW_SIZE)
     hop = int(sample_rate * WINDOW_STRIDE)
     if len(signal) < n_fft:
         signal = np.pad(signal, (0, n_fft - len(signal)))
-    window = np.hanning(n_fft)
+    window = np.hamming(n_fft)
     nframes = 1 + (len(signal) - n_fft) // hop
     frames = np.lib.stride_tricks.as_strided(
         signal,

@@ -1,7 +1,7 @@
 """Penn Treebank word-level LM data.
 
 Parity target: reference ptb_reader.py — vocab built from the training text
-(:14-24, word->id by first occurrence after <eos> substitution), corpus
+(:14-24, frequency-sorted word->id after <eos> substitution), corpus
 tokenized to one long id stream (:32-54), and `num_steps`-windowed LM samples
 with next-token targets (TrainDataset/TestDataset :56-102). Synthetic twin
 generates a Markov-ish id stream with the same vocab size so the lstm
@@ -10,6 +10,7 @@ workload runs without the dataset files.
 
 from __future__ import annotations
 
+import collections
 import os
 from typing import Optional
 
@@ -22,13 +23,16 @@ NUM_STEPS = 35  # reference BPTT window (dl_trainer.py:459)
 
 
 def build_vocab(path: str) -> dict[str, int]:
-    vocab: dict[str, int] = {}
+    """Frequency-sorted vocab (reference _build_vocab, ptb_reader.py:14-24:
+    ids assigned by (-count, word) order, so id 0 = most frequent word;
+    the ordering is an arbitrary relabeling for the model, but matching it
+    makes tokenized streams comparable token-for-token)."""
+    counter: collections.Counter = collections.Counter()
     with open(path) as f:
         for line in f:
-            for w in line.split() + ["<eos>"]:
-                if w not in vocab:
-                    vocab[w] = len(vocab)
-    return vocab
+            counter.update(line.split() + ["<eos>"])
+    pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+    return {w: i for i, (w, _) in enumerate(pairs)}
 
 
 def tokenize(path: str, vocab: dict[str, int]) -> np.ndarray:

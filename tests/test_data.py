@@ -240,3 +240,40 @@ def test_audio_bucketing_sorted_and_sharded():
     for b in l0:
         spread = b["input_lengths"].max() - b["input_lengths"].min()
         assert spread <= 60
+
+
+def test_ptb_vocab_frequency_sorted(tmp_path):
+    """Reference _build_vocab (ptb_reader.py:14-24): ids by (-count, word),
+    id 0 = most frequent; ties break alphabetically."""
+    from mgwfbp_tpu.data.ptb import build_vocab, tokenize
+
+    p = tmp_path / "train.txt"
+    p.write_text("b a b c\nb a\n")
+    # counts: b=3, a=2, <eos>=2, c=1 -> ids: b=0, <eos>=1 (tie with a,
+    # '<eos>' < 'a' lexicographically), a=2, c=3
+    v = build_vocab(str(p))
+    assert v == {"b": 0, "<eos>": 1, "a": 2, "c": 3}
+    ids = tokenize(str(p), v)
+    assert ids.tolist() == [0, 2, 0, 3, 1, 0, 2, 1]
+
+
+def test_spectrogram_uses_hamming_window():
+    """Reference audio_conf window='hamming' (models/lstman4.py:8-19)."""
+    import numpy as np
+
+    from mgwfbp_tpu.data.audio import log_spectrogram
+
+    rs = np.random.RandomState(0)
+    sig = rs.randn(16000).astype(np.float32)
+    got = log_spectrogram(sig)
+    assert got.shape[1] == 161 and np.isfinite(got).all()
+    # reproduce with an explicit hamming pipeline; a hann-windowed variant
+    # must NOT match
+    n_fft, hop = 320, 160
+    nf = 1 + (len(sig) - n_fft) // hop
+    frames = np.stack([sig[i * hop: i * hop + n_fft] for i in range(nf)])
+    for window, should_match in ((np.hamming(n_fft), True),
+                                 (np.hanning(n_fft), False)):
+        sp = np.log1p(np.abs(np.fft.rfft(frames * window, axis=1)))
+        sp = (sp - sp.mean()) / (sp.std() + 1e-6)
+        assert np.allclose(got, sp, atol=1e-5) == should_match
