@@ -10,7 +10,9 @@ models/lstman4.py:8-33. Loss is CTC — warp-ctc in the reference
 
 TPU re-design notes: NHWC convs on (B, T, F, 1) spectrograms; fixed padded T
 with explicit length masking (no pack_padded_sequence — static shapes for
-XLA); bidirectional layers via flax.linen.Bidirectional over lax.scan.
+XLA). Default topology matches the reference's an4 config: unidirectional
+RNN layers + Lookahead convolution; bidirectional=True swaps in paired
+forward/reverse nn.RNN scans with summed directions.
 """
 
 from __future__ import annotations
@@ -116,12 +118,15 @@ class Lookahead(nn.Module):
 class DeepSpeech(nn.Module):
     """conv stack + nb_layers x BatchRNN + SequenceWise BN + classifier
     (reference lstm_models.py:148-321; defaults from models/lstman4.py:8-33:
-    LSTM, hidden 800, 5 layers, bidirectional)."""
+    LSTM, hidden 800, 5 layers, UNIDIRECTIONAL + Lookahead — the reference's
+    create_net default is bidirectional=False, so its an4 headline config
+    runs the lookahead-convolution variant; bidirectional=True remains
+    selectable)."""
 
     num_classes: int = 29
     hidden_size: int = 800
     num_layers: int = 5
-    bidirectional: bool = True
+    bidirectional: bool = False
     sample_rate: int = 16000
     window_size: float = 0.02
 

@@ -221,3 +221,30 @@ def test_parameter_counts_match_canonical_imagenet():
         "lstm": 66_022_000,
     }.items():
         assert _param_count(name) == want, name
+
+
+def test_deepspeech_default_is_unidirectional_lookahead():
+    """The reference's an4 config runs create_net defaults
+    (models/lstman4.py:8: bidirectional=False), i.e. the unidirectional +
+    Lookahead variant; the registry default must match, with bidirectional
+    selectable."""
+    from mgwfbp_tpu.models.deepspeech import DeepSpeech
+
+    m = DeepSpeech(num_classes=29, hidden_size=8, num_layers=1)
+    assert m.bidirectional is False
+    x = jnp.zeros((2, 32, 161), jnp.float32)
+    v = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    # lookahead layer present in the unidirectional param tree
+    names = " ".join(
+        jax.tree_util.keystr(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(v["params"])[0]
+    )
+    assert "Lookahead" in names
+    bi = DeepSpeech(num_classes=29, hidden_size=8, num_layers=1,
+                    bidirectional=True)
+    vb = bi.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    bnames = " ".join(
+        jax.tree_util.keystr(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(vb["params"])[0]
+    )
+    assert "Lookahead" not in bnames
