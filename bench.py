@@ -48,7 +48,7 @@ _PEAK_FLOPS = [
     ("cpu", 1e11),
 ]
 
-_POLICIES = ("mgwfbp", "wfbp", "single", "none")
+_POLICIES = ("mgwfbp", "auto", "wfbp", "single", "none")
 
 
 def _peak_flops(device_kind: str) -> float | None:
@@ -112,7 +112,7 @@ def _bench_policy(
             state.params,
             axis_name=DATA_AXIS,
             policy=policy,
-            tb=tb if policy == "mgwfbp" else None,
+            tb=tb if policy in ("mgwfbp", "auto") else None,
             cost_model=lookup_alpha_beta("ici", max(n_dev, 2)),
             comm_op=os.environ.get("MGWFBP_BENCH_COMM_OP", "all_reduce"),
         )

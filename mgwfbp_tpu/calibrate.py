@@ -27,45 +27,90 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="largest payload (log2 elements)")
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--no-gamma", action="store_true",
+                   help="skip the per-collective overhead (gamma) fit")
+    p.add_argument("--gamma-total-log2", type=int, default=22,
+                   help="fixed total payload for the gamma fit (log2 elems)")
+    p.add_argument("--world-sizes", default=None,
+                   help="comma list of data-axis extents to calibrate (e.g. "
+                        "2,4,8): produces a 'family' profile whose per-P "
+                        "alpha-beta-gamma replace the invented alpha-vs-hops "
+                        "prior with measured trend")
     args = p.parse_args(argv)
 
     from mgwfbp_tpu.utils.platform import apply_platform_overrides
 
     apply_platform_overrides()
-    from mgwfbp_tpu.parallel.costmodel import save_profile
+    import dataclasses
+
+    from mgwfbp_tpu.parallel.costmodel import ProfileFamily, save_profile
     from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
-    from mgwfbp_tpu.profiling import profile_allreduce
+    from mgwfbp_tpu.profiling import profile_allreduce, profile_group_overhead
 
     import jax
 
-    mesh = make_mesh(MeshSpec())
     sizes = tuple(2**k for k in range(args.min_log2, args.max_log2 + 1))
-    prof = profile_allreduce(
-        mesh, sizes=sizes, warmup=args.warmup, iters=args.iters
-    )
+
+    def calibrate_mesh(mesh):
+        prof = profile_allreduce(
+            mesh, sizes=sizes, warmup=args.warmup, iters=args.iters
+        )
+        model = prof.model
+        gsamples = None
+        if not args.no_gamma:
+            gamma, gsamples = profile_group_overhead(
+                mesh, alpha=model.alpha,
+                total_elems=2**args.gamma_total_log2,
+            )
+            model = dataclasses.replace(model, gamma=gamma)
+        return model, prof, gsamples
+
+    meta = {
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": len(jax.devices()),
+        "payload_log2_range": [args.min_log2, args.max_log2],
+        "iters": args.iters,
+    }
+    if args.world_sizes:
+        extents = sorted({int(s) for s in args.world_sizes.split(",")})
+        avail = len(jax.devices())
+        entries = {}
+        summary = {}
+        for n in extents:
+            if n > avail:
+                raise SystemExit(
+                    f"--world-sizes {n}: only {avail} devices available"
+                )
+            mesh = make_mesh(MeshSpec(data=n), devices=jax.devices()[:n])
+            model, _, _ = calibrate_mesh(mesh)
+            entries[n] = model
+            summary[str(n)] = {
+                "alpha_s": model.alpha,
+                "beta_s_per_byte": model.beta,
+                "gamma_s": model.gamma,
+            }
+        out_model = ProfileFamily(entries=entries)
+        meta["world_sizes"] = extents
+        report = {"family": summary, "out": args.out}
+    else:
+        mesh = make_mesh(MeshSpec())
+        out_model, prof, gamma_samples = calibrate_mesh(mesh)
+        if gamma_samples:
+            meta["gamma_samples_s"] = [
+                [k, round(t, 6)] for k, t in gamma_samples
+            ]
+        report = {
+            "alpha_s": out_model.alpha,
+            "beta_s_per_byte": out_model.beta,
+            "gamma_s": out_model.gamma,
+            "samples": len(prof.sizes_bytes),
+            "out": args.out,
+        }
     import os
 
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    save_profile(
-        args.out,
-        prof.model,
-        meta={
-            "device_kind": jax.devices()[0].device_kind,
-            "n_devices": len(jax.devices()),
-            "payload_log2_range": [args.min_log2, args.max_log2],
-            "iters": args.iters,
-        },
-    )
-    print(
-        json.dumps(
-            {
-                "alpha_s": prof.model.alpha,
-                "beta_s_per_byte": prof.model.beta,
-                "samples": len(prof.sizes_bytes),
-                "out": args.out,
-            }
-        )
-    )
+    save_profile(args.out, out_model, meta=meta)
+    print(json.dumps(report))
     return 0
 
 

@@ -35,9 +35,21 @@ from mgwfbp_tpu.data.loader import (
 from mgwfbp_tpu.data.sharding import ShardInfo
 
 # Synthetic sizes: big enough for stable throughput measurement and smoke
-# convergence, small enough to build instantly.
+# convergence, small enough to build instantly. MGWFBP_SYNTH_TRAIN_N /
+# MGWFBP_SYNTH_VAL_N override them (full-cardinality convergence runs), and
+# MGWFBP_SYNTH_MODE=hard swaps the trivial twin for the held-out
+# generalization generator (datasets.synthetic_images_hard) — the honest
+# convergence substitute in this no-egress container.
 _SYNTH_TRAIN = {"mnist": 4096, "cifar10": 4096, "imagenet": 512, "ptb": 512}
 _SYNTH_VAL = {"mnist": 512, "cifar10": 512, "imagenet": 128, "ptb": 64}
+
+
+def _synth_size(split: str, name: str) -> int:
+    import os
+
+    table = _SYNTH_TRAIN if split == "train" else _SYNTH_VAL
+    env = os.environ.get(f"MGWFBP_SYNTH_{split.upper()}_N")
+    return int(env) if env else table[name]
 
 
 @dataclasses.dataclass
@@ -97,9 +109,16 @@ def data_prepare(
                 raise FileNotFoundError(
                     f"real {name} data not found under {data_dir!r}"
                 )
+            import os as _os
+
             nc = 1000 if name == "imagenet" else 10
-            train = synthetic_images(_SYNTH_TRAIN[name], (h, w, c), nc, seed)
-            val = synthetic_images(_SYNTH_VAL[name], (h, w, c), nc, seed + 1)
+            gen = synthetic_images
+            if _os.environ.get("MGWFBP_SYNTH_MODE", "easy") == "hard":
+                from mgwfbp_tpu.data.datasets import synthetic_images_hard
+
+                gen = synthetic_images_hard
+            train = gen(_synth_size("train", name), (h, w, c), nc, seed)
+            val = gen(_synth_size("val", name), (h, w, c), nc, seed + 1)
         else:
             real_hw = tuple(train.data.shape[1:3])
             if image_hw is not None and real_hw != tuple(image_hw):

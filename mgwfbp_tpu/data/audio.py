@@ -124,14 +124,27 @@ class AudioBatchLoader:
         self.seed = seed
         self.shuffle_batches = shuffle_batches
         self.epoch = 0
+        self._rebatch()
+
+    def _rebatch(self) -> None:
         # duration-sorted contiguous batches, then rank round-robin
-        nb = len(self.utts) // batch_size
+        bs = self.batch_size
+        nb = len(self.utts) // bs
         self._global_batches = [
-            list(range(b * batch_size, (b + 1) * batch_size)) for b in range(nb)
+            list(range(b * bs, (b + 1) * bs)) for b in range(nb)
         ]
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+
+    def set_batch_size(self, batch_size: int) -> None:
+        """Re-batch the precomputed duration-sorted groups at a new size
+        (batching is EAGER here, unlike ShardedLoader, so mutating the
+        attribute alone would silently keep the old batches)."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = min(batch_size, len(self.utts))
+        self._rebatch()
 
     @property
     def num_batches(self) -> int:

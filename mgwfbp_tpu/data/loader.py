@@ -70,6 +70,14 @@ class ShardedLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
+    def set_batch_size(self, batch_size: int) -> None:
+        """Re-batch the same shard (e.g. a larger eval batch,
+        MGWFBP_EVAL_BATCH); batching here is lazy so the attribute IS the
+        behavior."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = batch_size
+
     @property
     def num_batches(self) -> int:
         per_rank = len(

@@ -230,6 +230,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         # better for accuracy, lower for lstm perplexity / an4 WER)
         best = None
         best_epoch = None
+        key = lower_better = None
         for metrics in evaluate_all(
             args.dnn,
             args.checkpoint_dir,
@@ -237,12 +238,17 @@ def main(argv: Optional[list[str]] = None) -> int:
             **overrides,
         ):
             print(json.dumps(metrics))
-            if "wer" in metrics:
-                key, lower_better = "wer", True
-            elif "perplexity" in metrics:
-                key, lower_better = "perplexity", True
-            else:
-                key, lower_better = "top1", False
+            if key is None:
+                # the metric key is a property of the MODEL TASK, fixed for
+                # the whole run; deriving it per line would let one epoch
+                # with a missing key (e.g. failed WER decode) relabel the
+                # final best summary (ADVICE r3)
+                if "wer" in metrics:
+                    key, lower_better = "wer", True
+                elif "perplexity" in metrics:
+                    key, lower_better = "perplexity", True
+                else:
+                    key, lower_better = "top1", False
             v = metrics.get(key)
             if v is not None and (
                 best is None or (v < best if lower_better else v > best)

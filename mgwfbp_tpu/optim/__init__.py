@@ -51,9 +51,20 @@ def sgd(
     return optax.chain(*parts)
 
 
-def clip_by_global_norm(max_norm: float):
+def clip_by_global_norm(max_norm: float, world_size: int = 1):
     """Gradient clipping transform (reference clip_grad_norm_ for the RNN
-    workloads, dist_trainer.py:56-60,89-94: lstm 0.25, lstman4 400)."""
+    workloads, dist_trainer.py:56-60,89-94: lstm 0.25, lstman4 400).
+
+    When distributed, the threshold is scaled by sqrt(1/P) — the reference's
+    distributed clip rule (distributed_optimizer.py:380-387): worker-averaged
+    gradients have ~sqrt(1/P) the noise norm, so the threshold tightens to
+    match. Known delta (PARITY.md): the reference applies that threshold to
+    each MERGED GROUP's norm separately (a per-bucket approximation of the
+    global clip its single-process path uses); here the principled global-norm
+    clip keeps single/multi-worker semantics identical.
+    """
+    if world_size > 1:
+        max_norm = float(jnp.sqrt(1.0 / world_size)) * max_norm
     return optax.clip_by_global_norm(max_norm)
 
 
@@ -70,11 +81,14 @@ def make_optimizer(
     norm_clip: Optional[float] = None,
     step_offset: int = 0,
     epoch_offset: float = 0.0,
+    world_size: int = 1,
 ) -> tuple[optax.GradientTransformation, EpochSchedule]:
     """Build the full optimizer chain + its epoch schedule (for logging).
 
     step_offset/epoch_offset anchor the step->epoch conversion so an elastic
-    resize continues the schedule from its current position (as_step_fn)."""
+    resize continues the schedule from its current position (as_step_fn).
+    world_size scales the norm-clip threshold by sqrt(1/P) (reference
+    distributed clip rule, distributed_optimizer.py:380-387)."""
     epoch_schedule = resolve(
         lr_schedule, base_lr, dataset=dataset, max_epochs=max_epochs,
         warmup_epochs=warmup_epochs,
@@ -85,7 +99,9 @@ def make_optimizer(
     )
     tx = sgd(step_fn, momentum=momentum, weight_decay=weight_decay)
     if norm_clip is not None:
-        tx = optax.chain(clip_by_global_norm(norm_clip), tx)
+        tx = optax.chain(
+            clip_by_global_norm(norm_clip, world_size=world_size), tx
+        )
     return tx, epoch_schedule
 
 

@@ -315,7 +315,7 @@ def make_merged_allreduce(
         LayerSpec(name=nm, size=_numel(l), itemsize=jnp.dtype(l.dtype).itemsize)
         for nm, l in zip(names_arr, arr)
     ]
-    if policy == "mgwfbp" and tb is None:
+    if policy in ("mgwfbp", "auto") and tb is None:
         # Fallback prior when no measured profile exists: SHAPE from
         # parameter volume, SCALE from the cost model — total backward time
         # taken as the predicted time to all-reduce the whole model once
@@ -342,7 +342,8 @@ def make_merged_allreduce(
         if tb is not None and cost_model is not None:
             sizes_b = [s.nbytes for s in specs]
             total, nonoverlap, comm = simulate_groups(
-                layout.groups, sizes_b, tb, cost_model.predict
+                layout.groups, sizes_b, tb, cost_model.predict,
+                float(getattr(cost_model, "gamma", 0.0)),
             )
             schedule = dataclasses.replace(
                 schedule,

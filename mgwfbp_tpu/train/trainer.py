@@ -147,12 +147,14 @@ class Trainer:
         self._profile_backward_enabled = profile_backward
         self.reducer = self._build_reducer(profile_backward)
         if self.reducer is not None:
+            detail = self.reducer.schedule.policy_detail
             self.log.info(
                 "merge schedule: %d groups over %d tensors "
-                "(policy=%s, predicted nonoverlap %.3g s)",
+                "(policy=%s%s, predicted nonoverlap %.3g s)",
                 self.reducer.schedule.num_groups,
                 len(self.reducer.schedule.layer_names),
                 config.policy,
+                f" -> {detail}" if detail else "",
                 self.reducer.schedule.predicted_nonoverlap_time,
             )
         self._build_steps()
@@ -166,7 +168,7 @@ class Trainer:
     def _build_loaders(self):
         """Sharded data loaders at the current process batch (shared by
         __init__ and update_nworker so the two can never drift)."""
-        return data_prepare(
+        bundle = data_prepare(
             self.config.dataset,
             data_dir=self.config.data_dir,
             batch_size=self.process_batch,
@@ -177,6 +179,13 @@ class Trainer:
             augment=self.config.augment,
             num_steps=self.config.num_steps,
         )
+        # eval batch is decoupled from the train batch (MGWFBP_EVAL_BATCH):
+        # eval cost is dominated by per-batch dispatch/transfer round trips
+        # on a tunneled chip, and carry-free eval has no batch-size semantics
+        eval_bs = os.environ.get("MGWFBP_EVAL_BATCH")
+        if eval_bs and not self.meta.has_carry:
+            bundle.val.set_batch_size(max(int(eval_bs), 1))
+        return bundle
 
     def _build_optimizer(self) -> None:
         """(Re)build tx + the epoch LR schedule. The step->epoch conversion
@@ -203,6 +212,9 @@ class Trainer:
             norm_clip=config.norm_clip,
             step_offset=self._sched_step_offset,
             epoch_offset=self._sched_epoch_offset,
+            # reference distributed clip rule: threshold scales by sqrt(1/P)
+            # (re-baked on elastic resize since _build_optimizer reruns)
+            world_size=self.data_size,
         )
 
     def _build_steps(self) -> None:
@@ -401,7 +413,25 @@ class Trainer:
             )
             return None
         if cfg.comm_profile:
-            cost_model = load_profile(cfg.comm_profile)
+            from mgwfbp_tpu.parallel.costmodel import resolve_profile
+
+            # family profiles (P-sweep calibrations) pin to this run's
+            # data-parallel extent; flat/two-level load as-is
+            cost_model = resolve_profile(
+                load_profile(cfg.comm_profile), self.data_size
+            )
+            from mgwfbp_tpu.parallel.costmodel import TwoLevelAlphaBeta as _TL
+
+            if self.dcn_size > 1 and not isinstance(cost_model, _TL):
+                # ADVICE r3: a flat single-slice calibration silently
+                # mispricing the ICI+DCN hierarchy skews the merge solve
+                self.log.warning(
+                    "--comm-profile %s is a FLAT alpha-beta model but the "
+                    "mesh is multi-slice (dcn=%d): the profile prices the "
+                    "DCN hop as ICI. Calibrate a two-level profile (kind="
+                    "'two_level') for trustworthy merge schedules.",
+                    cfg.comm_profile, self.dcn_size,
+                )
         elif self.dcn_size > 1:
             # multi-slice: two-level model — ICI within a slice, DCN across
             from mgwfbp_tpu.parallel.costmodel import TwoLevelAlphaBeta
@@ -416,7 +446,7 @@ class Trainer:
             cost_model = lookup_alpha_beta(cfg.connection, self.data_size)
         self.cost_model = cost_model  # introspection (logs, tests)
         tb = None
-        if cfg.policy == "mgwfbp" and profile_backward:
+        if cfg.policy in ("mgwfbp", "auto") and profile_backward:
             if self._tb_cache is None:
                 self._tb_cache = self._profile_backward()
             # tb is per-device backward time at the per-device batch, which
@@ -606,6 +636,10 @@ class Trainer:
         max_steps = (
             cfg.num_batches_per_epoch if cfg.num_batches_per_epoch else None
         )
+        # each metrics log pulls device scalars to the host; through a
+        # tunneled chip one pull costs a full RTT (~50-80 ms measured,
+        # profiles/host_sync_tpu.json), so long runs raise the interval
+        log_interval = int(os.environ.get("MGWFBP_LOG_INTERVAL", "10"))
         metrics: dict = {}
         if self.meta.has_carry:
             # fresh hidden state each epoch (reference init_hidden per epoch)
@@ -629,7 +663,7 @@ class Trainer:
             epoch_steps += 1
             if max_steps is not None and epoch_steps >= max_steps:
                 break
-            if self.iteration % 10 == 0:
+            if self.iteration % log_interval == 0:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 dt = (time.time() - t_window) / max(window_iters, 1)
                 global_batch = cfg.batch_size * self.data_size * nsteps
@@ -680,6 +714,12 @@ class Trainer:
         """
         loader = self.bundle.val
         sums: dict[str, float] = {}
+        wer_total, wer_n = 0.0, 0
+        # single-process ctc: decode inputs come OUT of the loss forward
+        # (step.py per_device_ctc), so WER costs no second pass over the val
+        # set; multi-host logits are not fully addressable on one process,
+        # so that path keeps the separate local-shard decode pass.
+        fused_wer = self.meta.task == "ctc" and jax.process_count() == 1
         carry = (
             self._globalize(
                 self.model.initial_carry(self.process_batch), axes=0
@@ -722,10 +762,24 @@ class Trainer:
             )
             if self.meta.has_carry:
                 metrics, carry = self.eval_step(self.state, batch, carry)
+            elif self.meta.task == "ctc":
+                metrics, logits, out_lengths = self.eval_step(
+                    self.state, batch
+                )
+                if fused_wer:
+                    w, n = self._decode_wer_batch(
+                        np.asarray(logits), np.asarray(out_lengths), batch
+                    )
+                    wer_total += w
+                    wer_n += n
             else:
                 metrics = self.eval_step(self.state, batch)
             for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
+                # device-side accumulation: a float() here would pull one
+                # scalar PER BATCH to the host (a full RTT each through a
+                # tunneled chip); keep the adds async and pull once at the end
+                sums[k] = sums.get(k, 0.0) + v
+        sums = {k: float(v) for k, v in sums.items()}
         count = sums.pop("count", 0.0)
         out = {k: v / max(count, 1.0) for k, v in sums.items()}
         # seq-sharded eval counts each sample once per sequence shard (the
@@ -736,8 +790,31 @@ class Trainer:
             # reference reports per-token perplexity (dl_trainer.py:927-929)
             out["perplexity"] = float(np.exp(out.get("loss", 0.0)))
         if self.meta.task == "ctc":
-            out.update(self._evaluate_wer())
+            if fused_wer:
+                out["wer"] = wer_total / max(wer_n, 1)
+            else:
+                out.update(self._evaluate_wer())
         return out
+
+    def _decode_wer_batch(
+        self, logits: np.ndarray, out_lengths: np.ndarray, batch: dict
+    ) -> tuple[float, int]:
+        """Greedy-decode one already-computed eval batch; padded samples
+        (valid == 0) are skipped. Returns (sum of per-utterance WER, n)."""
+        from mgwfbp_tpu.data.audio import greedy_decode, ids_to_text, wer
+
+        valid = np.asarray(batch.get("valid", np.ones(len(logits))))
+        ys = np.asarray(batch["y"])
+        lab_lens = np.asarray(batch["label_lengths"])
+        hyps = greedy_decode(logits, out_lengths)
+        total, n = 0.0, 0
+        for j, hyp in enumerate(hyps):
+            if valid[j] == 0.0:
+                continue
+            ref = ids_to_text(ys[j][: int(lab_lens[j])])
+            total += wer(hyp, ref)
+            n += 1
+        return total, n
 
     def _evaluate_wer(self, max_batches: Optional[int] = None) -> dict:
         """Host-side greedy decode + WER over the FULL validation set

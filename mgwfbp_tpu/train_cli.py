@@ -39,8 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nsteps-update", dest="nsteps_update", type=int,
                    default=None, help="gradient accumulation micro-steps")
     p.add_argument("--policy", default=None,
-                   choices=["mgwfbp", "threshold", "single", "wfbp", "none"],
-                   help="merge policy; 'none' = XLA-fused oracle")
+                   choices=["mgwfbp", "auto", "threshold", "single", "wfbp",
+                            "none"],
+                   help="merge policy; 'auto' simulates every candidate "
+                        "schedule under the calibrated cost model and picks "
+                        "the argmin; 'none' = XLA-fused oracle")
     p.add_argument("--threshold", type=int, default=None,
                    help="elements per group for --policy threshold")
     p.add_argument("--connection", default=None,

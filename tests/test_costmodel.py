@@ -144,3 +144,40 @@ def test_choose_density_dense_for_small_sparse_for_huge():
     fast = AlphaBeta(alpha=1e-5, beta=1e-10)
     assert choose_density(5e8, 16, fast) == 1.0
     assert choose_density(0, 16, slow) == 1.0
+
+
+def test_profile_family_roundtrip_and_interp(tmp_path):
+    """P-sweep calibration profiles (VERDICT r3 #5): family save/load,
+    exact lookup, log2 interpolation of all three parameters, and alpha
+    extrapolation beyond the largest measured extent."""
+    from mgwfbp_tpu.parallel.costmodel import (
+        AlphaBeta, ProfileFamily, interp_alpha_beta, load_profile,
+        resolve_profile, save_profile,
+    )
+
+    fam = ProfileFamily(entries={
+        2: AlphaBeta(1e-4, 1e-9, 2e-4),
+        8: AlphaBeta(3e-4, 2e-9, 6e-4),
+    })
+    p = str(tmp_path / "fam.json")
+    save_profile(p, fam, meta={"world_sizes": [2, 8]})
+    back = load_profile(p)
+    assert isinstance(back, ProfileFamily)
+    assert back.at(2) == fam.entries[2]
+    # 4 is the log2 midpoint of {2, 8}: every parameter interpolates halfway
+    mid = back.at(4)
+    assert mid.alpha == pytest.approx(2e-4)
+    assert mid.beta == pytest.approx(1.5e-9)
+    assert mid.gamma == pytest.approx(4e-4)
+    # beyond the largest entry: alpha extrapolates by log2 ratio, beta/gamma
+    # hold at the largest measured
+    big = back.at(16)
+    assert big.alpha == pytest.approx(3e-4 * 4 / 3)
+    assert big.beta == pytest.approx(2e-9)
+    assert big.gamma == pytest.approx(6e-4)
+    # resolve_profile: families pin to the extent, flat models pass through
+    flat = AlphaBeta(1e-5, 1e-10)
+    assert resolve_profile(flat, 8) is flat
+    assert resolve_profile(back, 4) == mid
+    # below the smallest entry clamps
+    assert interp_alpha_beta(dict(fam.entries), 1) == fam.entries[2]

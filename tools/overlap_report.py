@@ -138,13 +138,15 @@ def _build_setup(model_name, batch, policy, nsteps, comm_profile=None):
     )
     reducer = None
     if policy not in ("none", "xla"):
+        from mgwfbp_tpu.parallel.costmodel import resolve_profile
+
         cost = (
-            load_profile(comm_profile)
+            resolve_profile(load_profile(comm_profile), max(n_dev, 2))
             if comm_profile
             else lookup_alpha_beta("ici", max(n_dev, 2))
         )
         tb = None
-        if policy == "mgwfbp":
+        if policy in ("mgwfbp", "auto"):
             paths = jax.tree_util.tree_flatten_with_path(state.params)[0]
             names = [jax.tree_util.keystr(kp) for kp, _ in paths]
             perm = arrival_order(len(names), names=names)

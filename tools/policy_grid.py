@@ -19,7 +19,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-POLICIES = ("mgwfbp", "wfbp", "single", "none")
+POLICIES = ("mgwfbp", "auto", "wfbp", "single", "none")
 
 
 def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup):
@@ -74,6 +74,18 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup):
                 and reducer.schedule.predicted_nonoverlap_time
                 == reducer.schedule.predicted_nonoverlap_time  # not NaN
                 else None
+            ),
+            "predicted_total_s": (
+                reducer.schedule.predicted_total_time
+                if reducer is not None
+                and reducer.schedule.predicted_total_time
+                == reducer.schedule.predicted_total_time
+                else None
+            ),
+            **(
+                {"policy_detail": reducer.schedule.policy_detail}
+                if reducer is not None and reducer.schedule.policy_detail
+                else {}
             ),
         }
         shared = {

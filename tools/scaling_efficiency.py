@@ -140,7 +140,17 @@ def run(model_name, batch, policy, comm_profile, targets, iters, warmup,
         if n == 1:
             reducer = None  # no communication exists on one device
         else:
-            cm = flat_model or lookup_alpha_beta("ici", n)
+            # ADVICE r3: a profile calibrated at ONE world size must not be
+            # reused verbatim at every extent. Family profiles resolve per
+            # extent (measured trend); a flat profile is resolved as-is and
+            # the artifact records that caveat.
+            from mgwfbp_tpu.parallel.costmodel import resolve_profile
+
+            cm = (
+                resolve_profile(flat_model, n)
+                if flat_model is not None
+                else lookup_alpha_beta("ici", n)
+            )
             reducer = make_merged_allreduce(
                 state.params, axis_name=DATA_AXIS, policy=policy, tb=tb,
                 cost_model=cm,
@@ -210,6 +220,21 @@ def run(model_name, batch, policy, comm_profile, targets, iters, warmup,
         "device_kind": jax.devices()[0].device_kind,
         "available_devices": avail,
         "comm_profile": comm_profile,
+        "comm_profile_kind": (
+            None if flat_model is None else type(flat_model).__name__
+        ),
+        "comm_profile_note": (
+            None
+            if flat_model is None
+            else (
+                "family profile: alpha-beta-gamma resolved per measured "
+                "extent (log2 interpolation between calibrated world sizes)"
+                if type(flat_model).__name__ == "ProfileFamily"
+                else "flat profile calibrated at one world size, applied "
+                "AS-IS at every measured extent (no alpha-vs-hops rescale); "
+                "prefer a --world-sizes family calibration"
+            )
+        ),
         "tb_total_s": round(sum(tb), 6),
         "t1_sec_per_iter": round(t1, 6),
         "measured_weak_scaling": measured,
