@@ -29,6 +29,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--no-gamma", action="store_true",
                    help="skip the per-collective overhead (gamma) fit")
+    p.add_argument("--no-overlap", action="store_true",
+                   help="skip the comm/compute overlap-capability probe")
     p.add_argument("--gamma-total-log2", type=int, default=22,
                    help="fixed total payload for the gamma fit (log2 elems)")
     p.add_argument("--world-sizes", default=None,
@@ -43,9 +45,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     apply_platform_overrides()
     import dataclasses
 
-    from mgwfbp_tpu.parallel.costmodel import ProfileFamily, save_profile
+    from mgwfbp_tpu.parallel.costmodel import (
+        ProfileFamily,
+        SampledCost,
+        save_profile,
+    )
     from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
-    from mgwfbp_tpu.profiling import profile_allreduce, profile_group_overhead
+    from mgwfbp_tpu.profiling import (
+        profile_allreduce,
+        profile_group_overhead,
+        profile_overlap_capability,
+    )
 
     import jax
 
@@ -55,14 +65,25 @@ def main(argv: Optional[list[str]] = None) -> int:
         prof = profile_allreduce(
             mesh, sizes=sizes, warmup=args.warmup, iters=args.iters
         )
-        model = prof.model
-        gsamples = None
+        gamma, gsamples = 0.0, None
         if not args.no_gamma:
             gamma, gsamples = profile_group_overhead(
-                mesh, alpha=model.alpha,
+                mesh, alpha=prof.model.alpha,
                 total_elems=2**args.gamma_total_log2,
             )
-            model = dataclasses.replace(model, gamma=gamma)
+        overlap = 1.0
+        if not args.no_overlap:
+            overlap = profile_overlap_capability(mesh)
+        # the sampled curve (not just the 2-parameter fit) is the persisted
+        # predictor: one flat beta cannot describe payload-dependent
+        # per-byte cost (cache regimes on CPU, DMA pipelining on TPU)
+        model = SampledCost(
+            sizes_bytes=tuple(prof.sizes_bytes),
+            times_s=tuple(prof.times_s),
+            ab=prof.model,
+            gamma=gamma,
+            overlap=overlap,
+        )
         return model, prof, gsamples
 
     meta = {
@@ -88,6 +109,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "alpha_s": model.alpha,
                 "beta_s_per_byte": model.beta,
                 "gamma_s": model.gamma,
+                "overlap": model.overlap,
             }
         out_model = ProfileFamily(entries=entries)
         meta["world_sizes"] = extents
@@ -103,6 +125,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             "alpha_s": out_model.alpha,
             "beta_s_per_byte": out_model.beta,
             "gamma_s": out_model.gamma,
+            "overlap": out_model.overlap,
             "samples": len(prof.sizes_bytes),
             "out": args.out,
         }

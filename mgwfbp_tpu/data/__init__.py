@@ -28,11 +28,34 @@ from mgwfbp_tpu.data.datasets import (
 )
 from mgwfbp_tpu.data.loader import (
     ArrayDataset,
+    PrefetchLoader,
     ShardedLoader,
     infinite_batches,
     normalize_images,
 )
 from mgwfbp_tpu.data.sharding import ShardInfo
+
+
+def _wrap_prefetch(train_loader):
+    """Background prefetch for the TRAIN path (reference DataLoader
+    num_workers + pin_memory, dl_trainer.py:353). MGWFBP_DATA_WORKERS
+    tunes the pool (0 disables and returns the bare loader);
+    MGWFBP_DATA_DEVICE_PUT=1 additionally commits batches to device from
+    the worker threads (pin_memory analogue) — OPT-IN because device_put
+    from non-main threads exercises backend thread paths that experimental
+    platforms (the axon TPU tunnel here) may not handle; host-side
+    assembly-ahead alone already overlaps the load with compute, and the
+    actual transfer is async under jax dispatch."""
+    import os
+
+    workers = int(os.environ.get("MGWFBP_DATA_WORKERS", "2"))
+    if workers <= 0:
+        return train_loader
+    return PrefetchLoader(
+        train_loader,
+        workers=workers,
+        device_put=os.environ.get("MGWFBP_DATA_DEVICE_PUT", "0") == "1",
+    )
 
 # Synthetic sizes: big enough for stable throughput measurement and smoke
 # convergence, small enough to build instantly. MGWFBP_SYNTH_TRAIN_N /
@@ -153,7 +176,7 @@ def data_prepare(
             drop_last=False, transform=normalize,
         )
         return DataBundle(
-            train=train_loader,
+            train=_wrap_prefetch(train_loader),
             val=val_loader,
             num_classes=train.num_classes,
             synthetic=is_synth,
@@ -200,7 +223,7 @@ def data_prepare(
         train_loader = ShardedLoader(train, batch_size, shuffle=False, seed=seed)
         val_loader = ShardedLoader(val, batch_size, shuffle=False, seed=seed)
         return DataBundle(
-            train=train_loader,
+            train=_wrap_prefetch(train_loader),
             val=val_loader,
             num_classes=vocab_size,
             synthetic=is_synth,
@@ -209,7 +232,9 @@ def data_prepare(
     if name == "an4":
         from mgwfbp_tpu.data.audio import an4_prepare
 
-        return an4_prepare(data_dir, batch_size, shard, seed, synthetic)
+        bundle = an4_prepare(data_dir, batch_size, shard, seed, synthetic)
+        bundle.train = _wrap_prefetch(bundle.train)
+        return bundle
     raise ValueError(f"unknown dataset {dataset!r}")
 
 

@@ -93,31 +93,50 @@ class ShardedLoader:
     def __len__(self) -> int:
         return self.num_batches
 
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
+        if getattr(self, "_idx_epoch", None) != epoch:
+            self._idx = shard_indices(
+                len(self.dataset), self.shard, epoch, self.shuffle,
+                self.seed, self.drop_last,
+            )
+            self._idx_epoch = epoch
+        return self._idx
+
+    def prime_epoch(self, epoch: int) -> None:
+        """Precompute the epoch's shard permutation (PrefetchLoader calls
+        this once before fanning load_batch jobs to its pool, so workers
+        never race to build the cache)."""
+        self._epoch_indices(epoch)
+
+    def load_batch(self, epoch: int, b: int) -> tuple[np.ndarray, np.ndarray]:
+        """Assemble batch `b` of `epoch` (gather + transform), independently
+        of iterator state — the unit of work `PrefetchLoader` farms out to a
+        thread pool. Deterministic: (seed, epoch, rank, batch) fully name
+        the batch, so prefetched and inline assembly are bit-identical."""
+        idx = self._epoch_indices(epoch)
+        sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
+        x = _gather(self.dataset.data, sel)
+        y = self.dataset.labels[sel]
+        if self.transform is not None:
+            if getattr(self.transform, "wants_rng", False):
+                # per-(seed, epoch, rank, batch) stream: augmentation is
+                # deterministic per epoch and decorrelated across ranks
+                rng = np.random.default_rng(
+                    [self.seed, epoch, self.shard.rank, b]
+                )
+                x = self.transform(x, rng)
+            else:
+                x = self.transform(x)
+        return x, y
+
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        idx = shard_indices(
-            len(self.dataset), self.shard, self.epoch, self.shuffle,
-            self.seed, self.drop_last,
-        )
+        idx = self._epoch_indices(self.epoch)
         if self.drop_last:
             nb = len(idx) // self.batch_size
         else:
             nb = (len(idx) + self.batch_size - 1) // self.batch_size
-        wants_rng = getattr(self.transform, "wants_rng", False)
         for b in range(nb):
-            sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
-            x = _gather(self.dataset.data, sel)
-            y = self.dataset.labels[sel]
-            if self.transform is not None:
-                if wants_rng:
-                    # per-(seed, epoch, rank, batch) stream: augmentation is
-                    # deterministic per epoch and decorrelated across ranks
-                    rng = np.random.default_rng(
-                        [self.seed, self.epoch, self.shard.rank, b]
-                    )
-                    x = self.transform(x, rng)
-                else:
-                    x = self.transform(x)
-            yield x, y
+            yield self.load_batch(self.epoch, b)
 
 
 def _gather(data, sel: np.ndarray) -> np.ndarray:
@@ -131,6 +150,159 @@ def _gather(data, sel: np.ndarray) -> np.ndarray:
         return data[sel]
     usel, inverse = np.unique(sel, return_inverse=True)
     return np.asarray(data[usel.tolist()])[inverse]
+
+
+class PrefetchLoader:
+    """Background-prefetching wrapper around an epoch loader.
+
+    The reference feeds its GPUs through
+    `DataLoader(num_workers=NUM_CPU_THREADS, pin_memory=True)` (reference
+    dl_trainer.py:353, :405); this is the same role without torch: batch
+    assembly (index gather + augmentation) runs in a thread pool AHEAD of
+    consumption, and each ready batch is optionally `jax.device_put` early
+    so the host->device transfer overlaps the previous step's compute
+    (double buffering; the put is async, the jitted step just consumes the
+    committed arrays). NumPy transforms release the GIL, so threads give
+    real parallelism without pickling costs.
+
+    Two modes:
+      * inner exposes `load_batch(epoch, b)` (ShardedLoader): `workers`
+        assemble batches concurrently, results consumed IN ORDER — output
+        is bit-identical to the inline loader for any worker count.
+      * otherwise (audio bucketing etc.): a single background thread runs
+        the inner iterator `depth` batches ahead.
+    """
+
+    def __init__(
+        self,
+        inner,
+        workers: int = 2,
+        depth: int = 2,
+        device_put: bool = False,
+    ):
+        self.inner = inner
+        self.workers = max(int(workers), 0)
+        self.depth = max(int(depth), 1)
+        self.device_put = device_put
+
+    # epoch/batch-size/len plumbing passes through to the inner loader
+    def set_epoch(self, epoch: int) -> None:
+        self.inner.set_epoch(epoch)
+
+    def set_batch_size(self, batch_size: int) -> None:
+        self.inner.set_batch_size(batch_size)
+
+    @property
+    def epoch(self):
+        return self.inner.epoch
+
+    @property
+    def batch_size(self):
+        return self.inner.batch_size
+
+    @property
+    def dataset(self):
+        return self.inner.dataset
+
+    @property
+    def num_batches(self) -> int:
+        return len(self.inner)
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def _finalize(self, batch):
+        if not self.device_put:
+            return batch
+        import jax
+
+        if jax.process_count() > 1:
+            # multi-host assembly pulls host numpy back out of the batch
+            # (make_array_from_process_local_data); early device_put would
+            # just bounce the bytes
+            return batch
+        return jax.device_put(batch)
+
+    def __iter__(self):
+        if self.workers == 0:
+            for batch in self.inner:
+                yield self._finalize(batch)
+            return
+        if hasattr(self.inner, "load_batch"):
+            yield from self._iter_pool()
+        else:
+            yield from self._iter_thread()
+
+    def _iter_pool(self):
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        nb = len(self.inner)
+        epoch = self.inner.epoch
+        # indices are epoch-cached on the inner loader; prime the cache once
+        # on this thread so pool workers only read it
+        if nb and hasattr(self.inner, "prime_epoch"):
+            self.inner.prime_epoch(epoch)
+        with ThreadPoolExecutor(max_workers=self.workers) as ex:
+
+            def job(b):
+                return self._finalize(self.inner.load_batch(epoch, b))
+
+            ahead = self.workers + self.depth
+            futs = collections.deque(
+                ex.submit(job, b) for b in range(min(ahead, nb))
+            )
+            next_b = len(futs)
+            while futs:
+                out = futs.popleft().result()  # in-order consumption
+                if next_b < nb:
+                    futs.append(ex.submit(job, next_b))
+                    next_b += 1
+                yield out
+
+    def _iter_thread(self):
+        import queue
+        import threading
+
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        _END = object()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer abandoned the
+            # iterator (otherwise an early `break` in the consumer — e.g. a
+            # step-capped epoch — would leave this thread blocked on a full
+            # queue forever, leaking it and its buffered batches)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def feed():
+            try:
+                for batch in self.inner:
+                    if not put(self._finalize(batch)):
+                        return
+                put(_END)
+            except BaseException as e:  # propagate into the consumer
+                put(e)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5)
 
 
 def infinite_batches(loader: ShardedLoader, start_epoch: int = 0):

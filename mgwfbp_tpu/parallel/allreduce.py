@@ -344,6 +344,7 @@ def make_merged_allreduce(
             total, nonoverlap, comm = simulate_groups(
                 layout.groups, sizes_b, tb, cost_model.predict,
                 float(getattr(cost_model, "gamma", 0.0)),
+                float(getattr(cost_model, "overlap", 1.0)),
             )
             schedule = dataclasses.replace(
                 schedule,

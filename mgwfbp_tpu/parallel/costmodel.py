@@ -44,6 +44,13 @@ class AlphaBeta:
     alpha: float
     beta: float
     gamma: float = 0.0
+    # fraction of collective time the platform can hide behind concurrent
+    # compute (calibrated by profiling.profile_overlap_capability): ~1.0 on
+    # real TPU ICI (async DMA collectives), ~0.0 on a virtual CPU mesh
+    # where compute and collective thunks serialize on the same cores. The
+    # reference model implicitly assumes 1.0 (NCCL streams); simulate_groups
+    # blends its overlapped and serialized timelines by this factor.
+    overlap: float = 1.0
 
     def predict(self, nbytes) -> float:
         return self.alpha + self.beta * nbytes
@@ -54,6 +61,53 @@ class AlphaBeta:
     @classmethod
     def from_json(cls, s: str) -> "AlphaBeta":
         return cls(**json.loads(s))
+
+
+@dataclasses.dataclass(frozen=True)
+class SampledCost:
+    """Measured all-reduce cost curve: predict by interpolating the raw
+    calibration samples instead of a single (alpha, beta) line.
+
+    One flat beta cannot describe a link whose per-byte cost depends on
+    payload (the reference models exactly this with separate small/large
+    Ethernet tables switching at 1 MB, utils.py:66-88; on a CPU mesh it is
+    cache physics). `predict` is piecewise-linear in log2(bytes) across the
+    measured samples; beyond the largest sample it extrapolates at the last
+    measured per-byte rate, below the smallest it floors at the first
+    sample. `ab` carries the least-squares fit for alpha (merge rule) and
+    for consumers that need a 2-parameter summary.
+    """
+
+    sizes_bytes: tuple[float, ...]
+    times_s: tuple[float, ...]
+    ab: AlphaBeta
+    gamma: float = 0.0
+    overlap: float = 1.0
+
+    @property
+    def alpha(self) -> float:
+        return self.ab.alpha
+
+    @property
+    def beta(self) -> float:
+        return self.ab.beta
+
+    def predict(self, nbytes) -> float:
+        xs = np.log2(np.maximum(np.asarray(self.sizes_bytes, np.float64), 1.0))
+        ys = np.asarray(self.times_s, np.float64)
+        b = float(max(nbytes, 1.0))
+        if b >= self.sizes_bytes[-1]:
+            # extrapolate at the marginal per-byte rate of the top interval
+            if len(ys) >= 2:
+                slope = max(
+                    (ys[-1] - ys[-2])
+                    / max(self.sizes_bytes[-1] - self.sizes_bytes[-2], 1.0),
+                    0.0,
+                )
+            else:
+                slope = ys[-1] / max(self.sizes_bytes[-1], 1.0)
+            return float(ys[-1] + (b - self.sizes_bytes[-1]) * slope)
+        return float(np.interp(np.log2(b), xs, ys))
 
 
 def predict_allreduce_time(alpha: float, beta: float, nbytes: float) -> float:
@@ -224,7 +278,8 @@ def interp_alpha_beta(
         base = table[known[-1]]
         scale = np.log2(nworkers) / np.log2(max(known[-1], 2))
         return AlphaBeta(
-            alpha=base.alpha * scale, beta=base.beta, gamma=base.gamma
+            alpha=base.alpha * scale, beta=base.beta, gamma=base.gamma,
+            overlap=base.overlap,
         )
     # intermediate count: log2-interpolate between the bracketing entries
     lo = max(k for k in known if k < nworkers)
@@ -233,7 +288,10 @@ def interp_alpha_beta(
     a = table[lo].alpha * (1 - t) + table[hi].alpha * t
     b = table[lo].beta * (1 - t) + table[hi].beta * t
     g = table[lo].gamma * (1 - t) + table[hi].gamma * t
-    return AlphaBeta(alpha=float(a), beta=float(b), gamma=float(g))
+    ov = table[lo].overlap * (1 - t) + table[hi].overlap * t
+    return AlphaBeta(
+        alpha=float(a), beta=float(b), gamma=float(g), overlap=float(ov)
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -246,12 +304,26 @@ class ProfileFamily:
     family on the live topology and `at(P)` resolves any extent by the same
     log2 interpolation the built-in tables use, replacing the invented
     `alpha * (1 + 0.1*hops)` prior shape with measured trend
-    (VERDICT r3 #5)."""
+    (VERDICT r3 #5). Entries may be `SampledCost` (full measured curves):
+    exact extents return the curve itself; intermediate extents fall back
+    to interpolating the 2-parameter summaries."""
 
-    entries: Mapping[int, AlphaBeta]
+    entries: Mapping[int, "AlphaBeta | SampledCost"]
 
-    def at(self, nworkers: int) -> AlphaBeta:
-        return interp_alpha_beta(dict(self.entries), nworkers)
+    def at(self, nworkers: int) -> "AlphaBeta | SampledCost":
+        if nworkers in self.entries:
+            return self.entries[nworkers]
+        summaries = {
+            k: (
+                dataclasses.replace(
+                    v.ab, gamma=v.gamma, overlap=v.overlap
+                )
+                if isinstance(v, SampledCost)
+                else v
+            )
+            for k, v in self.entries.items()
+        }
+        return interp_alpha_beta(summaries, nworkers)
 
 
 def resolve_profile(
@@ -389,10 +461,44 @@ class TwoLevelAlphaBeta:
             return self.ici.gamma
         return self.ici.gamma + self.dcn.gamma
 
+    @property
+    def overlap(self) -> float:
+        # a bucket's hierarchical collective is hidden only as well as its
+        # worst level
+        if self.dcn_size <= 1:
+            return self.ici.overlap
+        return min(self.ici.overlap, self.dcn.overlap)
+
+
+def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
+    if isinstance(model, SampledCost):
+        return {
+            "kind": "sampled",
+            "sizes_bytes": list(model.sizes_bytes),
+            "times_s": list(model.times_s),
+            "ab": dataclasses.asdict(model.ab),
+            "gamma": model.gamma,
+            "overlap": model.overlap,
+        }
+    return dataclasses.asdict(model)
+
+
+def _model_from_dict(d: dict) -> "AlphaBeta | SampledCost":
+    if d.get("kind") == "sampled":
+        return SampledCost(
+            sizes_bytes=tuple(d["sizes_bytes"]),
+            times_s=tuple(d["times_s"]),
+            ab=AlphaBeta(**d["ab"]),
+            gamma=d.get("gamma", 0.0),
+            overlap=d.get("overlap", 1.0),
+        )
+    d = {k: v for k, v in d.items() if k != "kind"}
+    return AlphaBeta(**d)
+
 
 def save_profile(
     path: str,
-    model: AlphaBeta | TwoLevelAlphaBeta | ProfileFamily,
+    model: "AlphaBeta | SampledCost | TwoLevelAlphaBeta | ProfileFamily",
     meta: Optional[dict] = None,
 ) -> None:
     """Persist a calibrated model; `meta` (device kind, mesh, date) is
@@ -403,9 +509,17 @@ def save_profile(
                 {
                     "kind": "family",
                     "entries": {
-                        str(k): dataclasses.asdict(v)
+                        str(k): _model_dict(v)
                         for k, v in sorted(model.entries.items())
                     },
+                    **({"meta": meta} if meta else {}),
+                },
+                f,
+            )
+        elif isinstance(model, SampledCost):
+            json.dump(
+                {
+                    **_model_dict(model),
                     **({"meta": meta} if meta else {}),
                 },
                 f,
@@ -433,13 +547,16 @@ def save_profile(
             )
 
 
-def load_profile(path: str) -> AlphaBeta | TwoLevelAlphaBeta | ProfileFamily:
-    """Load a calibration profile: 'flat' (one AlphaBeta), 'two_level'
-    (ICI+DCN), or 'family' (per-world-size AlphaBeta entries — resolve with
-    `resolve_profile(model, nworkers)` / `ProfileFamily.at`)."""
+def load_profile(
+    path: str,
+) -> "AlphaBeta | SampledCost | TwoLevelAlphaBeta | ProfileFamily":
+    """Load a calibration profile: 'flat' (one AlphaBeta), 'sampled'
+    (measured cost curve), 'two_level' (ICI+DCN), or 'family'
+    (per-world-size entries — resolve with `resolve_profile(model,
+    nworkers)` / `ProfileFamily.at`)."""
     with open(path) as f:
         d = json.load(f)
-    kind = d.pop("kind", "flat")
+    kind = d.get("kind", "flat")
     d.pop("meta", None)
     if kind == "two_level":
         return TwoLevelAlphaBeta(
@@ -451,7 +568,7 @@ def load_profile(path: str) -> AlphaBeta | TwoLevelAlphaBeta | ProfileFamily:
     if kind == "family":
         return ProfileFamily(
             entries={
-                int(k): AlphaBeta(**v) for k, v in d["entries"].items()
+                int(k): _model_from_dict(v) for k, v in d["entries"].items()
             }
         )
-    return AlphaBeta(**d)
+    return _model_from_dict(d)

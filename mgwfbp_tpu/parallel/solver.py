@@ -101,6 +101,7 @@ def simulate_groups(
     tb: Sequence[float],
     cost: CostFn,
     gamma: float = 0.0,
+    overlap: float = 1.0,
 ) -> tuple[float, float, float]:
     """Simulate the backward/comm overlap timeline for a fixed grouping.
 
@@ -112,6 +113,13 @@ def simulate_groups(
     costmodel.AlphaBeta.gamma): it lands on the step's critical path once per
     group, un-hideable by overlap, so it is added to both the total and the
     nonoverlap prediction.
+
+    `overlap` is the platform's calibrated capability to hide collectives
+    behind concurrent compute (costmodel.AlphaBeta.overlap): 1.0 gives the
+    reference's fully-async timeline, 0.0 a fully serialized one
+    (bwd + all comm back-to-back — the virtual CPU mesh regime, where
+    compute and collective thunks share the cores); intermediate values
+    blend the two linearly.
     """
     ready = np.cumsum(np.asarray(tb, dtype=np.float64))
     bwd_end = float(ready[-1]) if len(ready) else 0.0
@@ -124,8 +132,11 @@ def simulate_groups(
         link_free = start + t
         comm_sum += t
     overhead = gamma * len(list(groups))
-    total = max(bwd_end, link_free) + overhead
-    return total, max(link_free - bwd_end, 0.0) + overhead, comm_sum
+    total_hidden = max(bwd_end, link_free)
+    total_serial = bwd_end + comm_sum
+    ov = min(max(overlap, 0.0), 1.0)
+    total = ov * total_hidden + (1.0 - ov) * total_serial + overhead
+    return total, total - bwd_end, comm_sum
 
 
 def mgwfbp_groups(
@@ -250,6 +261,7 @@ def auto_groups(
     cost: CostFn,
     itemsize: int | Sequence[int] = 4,
     gamma: float = 0.0,
+    overlap: float = 1.0,
 ) -> tuple[list[list[int]], str]:
     """Simulate-and-argmin policy: evaluate every candidate schedule under
     the calibrated cost model (including gamma) and return the cheapest.
@@ -285,7 +297,7 @@ def auto_groups(
         th <<= 1
     best = None
     for detail, groups in candidates:
-        total, _, _ = simulate_groups(groups, nbytes, tb, cost, gamma)
+        total, _, _ = simulate_groups(groups, nbytes, tb, cost, gamma, overlap)
         if best is None or total < best[0]:
             best = (total, groups, detail)
     return best[1], best[2]
@@ -311,6 +323,9 @@ def build_schedule(
     names = tuple(l.name for l in layers)
     nbytes = [l.nbytes for l in layers]
     gamma = float(getattr(cost_model, "gamma", 0.0)) if cost_model else 0.0
+    overlap = (
+        float(getattr(cost_model, "overlap", 1.0)) if cost_model else 1.0
+    )
 
     detail = ""
     if policy == "mgwfbp":
@@ -334,6 +349,7 @@ def build_schedule(
             cost=cost_model.predict,
             itemsize=[l.itemsize for l in layers],
             gamma=gamma,
+            overlap=overlap,
         )
     elif policy == "threshold":
         groups = threshold_groups(sizes, threshold)
@@ -346,7 +362,7 @@ def build_schedule(
 
     if tb is not None and cost_model is not None and len(layers):
         total, nonoverlap, comm = simulate_groups(
-            groups, nbytes, tb, cost_model.predict, gamma
+            groups, nbytes, tb, cost_model.predict, gamma, overlap
         )
         group_times = predict_group_times(groups, nbytes, cost_model.predict)
     else:

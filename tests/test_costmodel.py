@@ -181,3 +181,48 @@ def test_profile_family_roundtrip_and_interp(tmp_path):
     assert resolve_profile(back, 4) == mid
     # below the smallest entry clamps
     assert interp_alpha_beta(dict(fam.entries), 1) == fam.entries[2]
+
+
+def test_sampled_cost_curve_and_roundtrip(tmp_path):
+    """Measured cost curves (r4): interpolation between samples, marginal
+    extrapolation past the largest, floor below the smallest; persisted and
+    reloaded exactly, standalone and inside a family."""
+    from mgwfbp_tpu.parallel.costmodel import (
+        AlphaBeta, ProfileFamily, SampledCost, load_profile, save_profile,
+    )
+
+    sc = SampledCost(
+        sizes_bytes=(1024.0, 4096.0, 16384.0),
+        times_s=(1e-4, 2e-4, 8e-4),
+        ab=AlphaBeta(9e-5, 4.5e-8),
+        gamma=3e-4,
+        overlap=0.25,
+    )
+    assert sc.predict(1024) == pytest.approx(1e-4)
+    assert sc.predict(16384) == pytest.approx(8e-4)
+    # log2 midpoint of (4096, 16384) -> time midpoint of (2e-4, 8e-4)
+    assert sc.predict(8192) == pytest.approx(5e-4)
+    # above the top: marginal rate of the last interval
+    slope = (8e-4 - 2e-4) / (16384 - 4096)
+    assert sc.predict(32768) == pytest.approx(8e-4 + 16384 * slope)
+    # below the bottom: startup floor
+    assert sc.predict(16) == pytest.approx(1e-4)
+    # 2-parameter summary passthrough for merge rule / legacy consumers
+    assert sc.alpha == pytest.approx(9e-5)
+    assert sc.beta == pytest.approx(4.5e-8)
+
+    p = str(tmp_path / "sc.json")
+    save_profile(p, sc)
+    back = load_profile(p)
+    assert isinstance(back, SampledCost)
+    assert back == sc
+    fam = ProfileFamily(entries={8: sc, 2: AlphaBeta(1e-5, 1e-9)})
+    pf = str(tmp_path / "fam.json")
+    save_profile(pf, fam)
+    fam2 = load_profile(pf)
+    assert fam2.at(8) == sc
+    # intermediate extent interpolates the 2-parameter summaries
+    mid = fam2.at(4)
+    assert isinstance(mid, AlphaBeta)
+    assert mid.gamma == pytest.approx(1.5e-4)
+    assert mid.overlap == pytest.approx(0.625)

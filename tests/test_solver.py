@@ -304,3 +304,28 @@ class TestGammaAndAuto:
         small = [1000] * 10                # tc - alpha = 1 us << gamma
         groups = mgwfbp_groups(small, tb, alpha=1e-4, cost=cost, gamma=1e-3)
         assert len(groups) == 1
+
+    def test_overlap_capability_blends_timelines(self):
+        # overlap=1: reference async timeline; overlap=0: fully serialized
+        # (bwd + all comm); the CPU-mesh regime where single-group wins.
+        from mgwfbp_tpu.parallel.solver import auto_groups, simulate_groups
+
+        sizes_b = [4000] * 10
+        tb = [5e-3] * 10
+        cost = linear_cost(0.0, 1e-7)  # 0.4 ms per small group, beta-only
+        groups = [[i] for i in range(10)]
+        t1, n1, c1 = simulate_groups(groups, sizes_b, tb, cost, overlap=1.0)
+        t0, n0, c0 = simulate_groups(groups, sizes_b, tb, cost, overlap=0.0)
+        assert c1 == pytest.approx(c0)
+        # hidden: only the tail group's comm sticks out; serial: all of it
+        assert t1 == pytest.approx(0.05 + 0.0004)
+        assert t0 == pytest.approx(0.05 + 10 * 0.0004)
+        th, _, _ = simulate_groups(groups, sizes_b, tb, cost, overlap=0.5)
+        assert t1 < th < t0
+        # with zero overlap and a gamma cost, auto must fuse to one group:
+        # beta cost is grouping-invariant, so only gamma differentiates
+        sizes = [1000] * 10
+        g, detail = auto_groups(
+            sizes, tb, alpha=0.0, cost=cost, gamma=3e-4, overlap=0.0
+        )
+        assert detail == "single"

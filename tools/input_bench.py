@@ -1,0 +1,224 @@
+"""Real-data input-path benchmark (VERDICT r3 #4): is the host pipeline
+fast enough to feed the chip?
+
+The r3 bench drove synthetic in-memory arrays; nothing measured a real
+epoch through file IO + decode + augmentation. This tool:
+
+  1. (--make-data) materializes the REAL file formats under --data-dir:
+     CIFAR-10 python-pickle batches (the torchvision on-disk layout
+     `cifar-10-batches-py/data_batch_*`) and the reference's single-file
+     HDF5 ImageNet (datasets.create_hdf5 — reference scripts/create_hdf5.py
+     layout). Content is the synthetic twin (no egress in this container);
+     the IO path — disk read, pickle/HDF5 decode, augmentation, batching —
+     is exactly the real-data path.
+  2. times Trainer-equivalent epochs over (a) in-memory synthetic and
+     (b) the real files, with the production prefetch pipeline
+     (PrefetchLoader) and with it disabled, reporting samples/s and the
+     real/synthetic throughput ratio. On a TPU host the interesting number
+     is the ratio at the bench batch: >= ~0.95 means the input path keeps
+     up (reference feeds GPUs via DataLoader num_workers, dl_trainer.py:353).
+
+Usage:
+  python tools/input_bench.py --make-data --data-dir /tmp/mgwfbp_data
+  python tools/input_bench.py --model resnet20 --data-dir /tmp/mgwfbp_data \
+      --iters 200 --out profiles/input_pipeline_tpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_data(data_dir: str, imagenet_n: int = 4096) -> dict:
+    import numpy as np
+
+    from mgwfbp_tpu.data.datasets import create_hdf5, synthetic_images_hard
+
+    os.makedirs(data_dir, exist_ok=True)
+    report = {}
+    # CIFAR-10 pickle batches (5 x 10k train + 10k test)
+    root = os.path.join(data_dir, "cifar-10-batches-py")
+    os.makedirs(root, exist_ok=True)
+    train = synthetic_images_hard(50000, (32, 32, 3), 10, seed=0)
+    test = synthetic_images_hard(10000, (32, 32, 3), 10, seed=1)
+    for i in range(5):
+        sel = slice(i * 10000, (i + 1) * 10000)
+        with open(os.path.join(root, f"data_batch_{i+1}"), "wb") as f:
+            pickle.dump(
+                {
+                    b"data": train.data[sel]
+                    .transpose(0, 3, 1, 2)
+                    .reshape(10000, -1),
+                    b"labels": train.labels[sel].tolist(),
+                },
+                f,
+            )
+    with open(os.path.join(root, "test_batch"), "wb") as f:
+        pickle.dump(
+            {
+                b"data": test.data.transpose(0, 3, 1, 2).reshape(10000, -1),
+                b"labels": test.labels.tolist(),
+            },
+            f,
+        )
+    report["cifar10"] = root
+    # single-file HDF5 ImageNet (reference key layout), synthetic content
+    tr = synthetic_images_hard(imagenet_n, (224, 224, 3), 1000, seed=2)
+    va = synthetic_images_hard(max(imagenet_n // 8, 128), (224, 224, 3),
+                               1000, seed=3)
+    path = os.path.join(data_dir, "imagenet.hdf5")
+    create_hdf5(tr.data, tr.labels, va.data, va.labels, path)
+    report["imagenet_hdf5"] = path
+    report["imagenet_n"] = imagenet_n
+    return report
+
+
+def _time_loader(bundle, step_fn, state, iters, to_batch):
+    """Drive the jitted step from the loader; end-sync via final loss pull."""
+    import jax
+
+    loader = bundle.train
+    loader.set_epoch(0)
+    n = 0
+    t0 = time.perf_counter()
+    m = None
+    while n < iters:
+        for raw in loader:
+            state, m = step_fn(state, to_batch(raw))
+            n += 1
+            if n >= iters:
+                break
+        loader.set_epoch(n)  # new epoch if the set is small
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / n
+    return dt, state
+
+
+def run(model_name, data_dir, iters, warmup, out):
+    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    import jax
+    import jax.numpy as jnp
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.config import PRESETS
+    from mgwfbp_tpu.data import data_prepare
+    from mgwfbp_tpu.optim import make_optimizer
+    from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mgwfbp_tpu.train import create_train_state, make_train_step
+
+    preset = PRESETS.get(model_name, {})
+    batch = preset.get("batch_size", 32)
+    dataset = preset.get("dataset", "cifar10")
+    model, meta = zoo.create_model(model_name)
+    tx, _ = make_optimizer(
+        0.1, lr_schedule="const", dataset=dataset, num_batches_per_epoch=1
+    )
+    mesh = make_mesh(MeshSpec(data=1))
+    compute_dtype = jnp.bfloat16
+    step = make_train_step(
+        model, meta, tx, mesh, None, compute_dtype=compute_dtype,
+        donate=False,
+    )
+
+    def to_batch(raw):
+        if isinstance(raw, dict):
+            return {k: jnp.asarray(v)[None] for k, v in raw.items()}
+        x, y = raw
+        return {"x": jnp.asarray(x)[None], "y": jnp.asarray(y)[None]}
+
+    def fresh_state():
+        return create_train_state(
+            jax.random.PRNGKey(0), model,
+            jnp.zeros((1,) + tuple(meta.input_shape), meta.input_dtype), tx,
+        )
+
+    results = {}
+    configs = [
+        ("synthetic_inmem", dict(synthetic=True), {}),
+        ("real_files", dict(synthetic=None), {}),
+        (
+            "real_files_no_prefetch",
+            dict(synthetic=None),
+            {"MGWFBP_DATA_WORKERS": "0"},
+        ),
+    ]
+    for name, kw, env in configs:
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            bundle = data_prepare(
+                dataset, data_dir=data_dir, batch_size=batch, **kw
+            )
+            if name != "synthetic_inmem" and bundle.synthetic:
+                results[name] = {"error": f"no real {dataset} files under {data_dir}"}
+                continue
+            state = fresh_state()
+            # warmup (compile + first batches)
+            _, state = _time_loader(bundle, step, state, warmup, to_batch)
+            dt, state = _time_loader(bundle, step, state, iters, to_batch)
+            results[name] = {
+                "sec_per_iter": round(dt, 6),
+                "samples_per_sec": round(batch / dt, 2),
+                "prefetch": type(bundle.train).__name__,
+            }
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    if (
+        "synthetic_inmem" in results
+        and "real_files" in results
+        and "sec_per_iter" in results.get("real_files", {})
+    ):
+        results["real_over_synthetic_throughput"] = round(
+            results["synthetic_inmem"]["sec_per_iter"]
+            / results["real_files"]["sec_per_iter"],
+            4,
+        )
+    payload = {
+        "model": model_name,
+        "dataset": dataset,
+        "batch": batch,
+        "iters": iters,
+        "device_kind": jax.devices()[0].device_kind,
+        "data_dir": data_dir,
+        "results": results,
+    }
+    text = json.dumps(payload, indent=1)
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            f.write(text)
+    print(text)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet20")
+    ap.add_argument("--data-dir", dest="data_dir", default="/tmp/mgwfbp_data")
+    ap.add_argument("--make-data", action="store_true")
+    ap.add_argument("--imagenet-n", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    if args.make_data:
+        print(json.dumps(make_data(args.data_dir, args.imagenet_n)))
+        return 0
+    return run(args.model, args.data_dir, args.iters, args.warmup, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,8 +112,38 @@ def summarize_overlap(logdir: str) -> dict:
     }
 
 
-def _build_setup(model_name, batch, policy, nsteps, comm_profile=None):
-    """Shared setup: model/state/reducer (measured-tb schedule) + step fn."""
+def measure_tb(model, meta, params, batch_stats, batch):
+    """One arrival-order backward profile for a model (shared by the
+    _build_setup fallback and tools/policy_grid.py, which measures ONCE and
+    feeds every policy's solve from the same numbers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mgwfbp_tpu.parallel.allreduce import arrival_order
+    from mgwfbp_tpu.profiling import benchmark_trainer_backward
+
+    paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = [jax.tree_util.keystr(kp) for kp, _ in paths]
+    perm = arrival_order(len(names), names=names)
+    micro = {
+        "x": jnp.zeros((batch,) + tuple(meta.input_shape), meta.input_dtype),
+        "y": jnp.zeros((batch,), jnp.int32),
+    }
+    return benchmark_trainer_backward(
+        model, meta, params, batch_stats, micro, perm,
+        warmup=1, iters=3, names=names,
+    )
+
+
+def _build_setup(model_name, batch, policy, nsteps, comm_profile=None,
+                 tb=None):
+    """Shared setup: model/state/reducer (measured-tb schedule) + step fn.
+
+    `tb`: pass a precomputed arrival-order backward profile so every policy
+    of an A/B grid is solved AND simulated from the same measurement
+    (tools/policy_grid.py measures once, reuses five times); by default tb
+    is measured here for the policies that need it (mgwfbp/auto).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -145,21 +175,8 @@ def _build_setup(model_name, batch, policy, nsteps, comm_profile=None):
             if comm_profile
             else lookup_alpha_beta("ici", max(n_dev, 2))
         )
-        tb = None
-        if policy in ("mgwfbp", "auto"):
-            paths = jax.tree_util.tree_flatten_with_path(state.params)[0]
-            names = [jax.tree_util.keystr(kp) for kp, _ in paths]
-            perm = arrival_order(len(names), names=names)
-            micro = {
-                "x": jnp.zeros(
-                    (batch,) + tuple(meta.input_shape), meta.input_dtype
-                ),
-                "y": jnp.zeros((batch,), jnp.int32),
-            }
-            tb = benchmark_trainer_backward(
-                model, meta, state.params, state.batch_stats, micro, perm,
-                warmup=1, iters=3, names=names,
-            )
+        if tb is None and policy in ("mgwfbp", "auto"):
+            tb = measure_tb(model, meta, state.params, state.batch_stats, batch)
         reducer = make_merged_allreduce(
             state.params, axis_name=DATA_AXIS, policy=policy,
             tb=tb, cost_model=cost,
