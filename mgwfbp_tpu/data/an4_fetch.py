@@ -38,19 +38,27 @@ AN4_URL = "http://www.speech.cs.cmu.edu/databases/an4/an4_raw.bigendian.tar.gz"
 SAMPLE_RATE = 16000
 
 
-def raw_to_wav(raw_bytes: bytes, wav_path: str) -> float:
-    """Big-endian s16 mono 16 kHz raw -> RIFF wav; returns duration (s).
-
-    Byte-identical samples to the reference's sox invocation (an4.py:40-43):
-    both merely byte-swap the PCM payload into little-endian s16.
-    """
-    pcm = np.frombuffer(raw_bytes, dtype=">i2").astype("<i2")
+def pcm_to_wav(pcm: np.ndarray, wav_path: str) -> float:
+    """int16 mono PCM -> 16 kHz RIFF wav; returns duration (s). The one
+    wav-writing contract shared by the AN4 and LibriSpeech fetchers."""
+    pcm = np.asarray(pcm, "<i2")
     with wave.open(wav_path, "wb") as w:
         w.setnchannels(1)
         w.setsampwidth(2)
         w.setframerate(SAMPLE_RATE)
         w.writeframes(pcm.tobytes())
     return len(pcm) / SAMPLE_RATE
+
+
+def raw_to_wav(raw_bytes: bytes, wav_path: str) -> float:
+    """Big-endian s16 mono 16 kHz raw -> RIFF wav; returns duration (s).
+
+    Byte-identical samples to the reference's sox invocation (an4.py:40-43):
+    both merely byte-swap the PCM payload into little-endian s16.
+    """
+    return pcm_to_wav(
+        np.frombuffer(raw_bytes, dtype=">i2").astype("<i2"), wav_path
+    )
 
 
 def process_transcript(line: str) -> str:
@@ -102,11 +110,42 @@ def salvage_tar(source: str) -> tuple[dict[str, bytes], bool]:
     return files, truncated
 
 
+def stream_tar_entries(source: str):
+    """Yield (name, bytes) per file member of a tar.gz, one at a time —
+    constant memory for arbitrarily large archives (LibriSpeech tarballs
+    are multi-GB; buffering them whole would OOM a typical host). Stops
+    cleanly at a truncated tail: consume the generator and check
+    `.truncated` on the returned iterator object."""
+
+    class _Iter:
+        truncated = False
+
+        def __iter__(self):
+            try:
+                with tarfile.open(source, "r|gz") as t:
+                    for m in t:
+                        if not m.isfile():
+                            continue
+                        fobj = t.extractfile(m)
+                        if fobj is None:
+                            continue
+                        payload = fobj.read()
+                        if len(payload) < m.size:
+                            self.truncated = True
+                            return
+                        yield m.name, payload
+            except (tarfile.ReadError, EOFError, OSError):
+                self.truncated = True
+
+    return _Iter()
+
+
 def _download(url: str, dest: str) -> None:
+    import shutil
     import urllib.request
 
     with urllib.request.urlopen(url, timeout=60) as r, open(dest, "wb") as f:
-        f.write(r.read())
+        shutil.copyfileobj(r, f, length=1 << 20)  # chunked, constant memory
 
 
 def fetch_an4(

@@ -84,6 +84,19 @@ class SampledCost:
     gamma: float = 0.0
     overlap: float = 1.0
 
+    def __post_init__(self):
+        # predict() is the solver's inner-loop cost function (auto_groups
+        # simulates every candidate schedule through it); precompute the
+        # interpolation arrays once instead of per call
+        object.__setattr__(
+            self,
+            "_xs",
+            np.log2(np.maximum(np.asarray(self.sizes_bytes, np.float64), 1.0)),
+        )
+        object.__setattr__(
+            self, "_ys", np.asarray(self.times_s, np.float64)
+        )
+
     @property
     def alpha(self) -> float:
         return self.ab.alpha
@@ -93,8 +106,7 @@ class SampledCost:
         return self.ab.beta
 
     def predict(self, nbytes) -> float:
-        xs = np.log2(np.maximum(np.asarray(self.sizes_bytes, np.float64), 1.0))
-        ys = np.asarray(self.times_s, np.float64)
+        xs, ys = self._xs, self._ys
         b = float(max(nbytes, 1.0))
         if b >= self.sizes_bytes[-1]:
             # extrapolate at the marginal per-byte rate of the top interval

@@ -146,3 +146,90 @@ def test_fetch_holds_out_val_when_test_split_lost(tmp_path):
         + report["splits"]["val"]["utterances"]
         == 12
     )
+
+
+class TestLibriSpeechFetch:
+    """LibriSpeech acquisition (reference audio_data/librispeech.py):
+    layout walk, trans.txt pairing, transcript normalization, duration
+    sort/prune, shared manifest format — testable without FLAC via wav
+    entries (this image ships no FLAC decoder; .flac errors actionably)."""
+
+    def _build_tar(self, utts, tmp_path, wav=True):
+        import tarfile as _tf
+
+        buf = io.BytesIO()
+        with _tf.open(fileobj=buf, mode="w:gz") as t:
+
+            def add(name, data):
+                info = _tf.TarInfo(name)
+                info.size = len(data)
+                t.addfile(info, io.BytesIO(data))
+
+            chapters = {}
+            for utt_id, text, seconds in utts:
+                spk, chap, _ = utt_id.split("-")
+                chapters.setdefault((spk, chap), []).append((utt_id, text))
+                raw = _tone_raw(seconds)
+                if wav:
+                    import wave as _wave
+
+                    wbuf = io.BytesIO()
+                    with _wave.open(wbuf, "wb") as w:
+                        w.setnchannels(1)
+                        w.setsampwidth(2)
+                        w.setframerate(16000)
+                        w.writeframes(
+                            np.frombuffer(raw, ">i2").astype("<i2").tobytes()
+                        )
+                    add(
+                        f"LibriSpeech/dev-clean/{spk}/{chap}/{utt_id}.wav",
+                        wbuf.getvalue(),
+                    )
+                else:
+                    add(
+                        f"LibriSpeech/dev-clean/{spk}/{chap}/{utt_id}.flac",
+                        b"fLaC fake",
+                    )
+            for (spk, chap), entries in chapters.items():
+                table = "".join(f"{u} {t}\n" for u, t in entries)
+                add(
+                    f"LibriSpeech/dev-clean/{spk}/{chap}/{spk}-{chap}.trans.txt",
+                    table.encode(),
+                )
+        src = str(tmp_path / "ls.tar.gz")
+        open(src, "wb").write(buf.getvalue())
+        return src
+
+    UTTS = [
+        ("84-121123-0001", "hello there", 2.0),
+        ("84-121123-0002", "general kenobi", 1.5),
+        ("84-121550-0000", "too short", 0.5),   # pruned on train
+        ("174-50561-0000", "another speaker", 3.0),
+    ]
+
+    def test_fetch_wav_archive(self, tmp_path):
+        from mgwfbp_tpu.data.audio import load_an4
+        from mgwfbp_tpu.data.librispeech_fetch import fetch_librispeech
+
+        src = self._build_tar(self.UTTS, tmp_path)
+        out = str(tmp_path / "ds")
+        report = fetch_librispeech(out, [src], split="train")
+        assert report["utterances"] == 3
+        assert report["duration_pruned"] == 1
+        # transcript normalized to upper case, paired per chapter table
+        utts = load_an4(out, "train")
+        assert len(utts) == 3
+        rows = open(report["manifest"]).read().splitlines()
+        txts = {open(r.split(",")[1]).read() for r in rows}
+        assert txts == {"HELLO THERE", "GENERAL KENOBI", "ANOTHER SPEAKER"}
+        # val split: no pruning
+        report_v = fetch_librispeech(out, [src], split="val")
+        assert report_v["utterances"] == 4
+
+    def test_flac_without_decoder_errors_actionably(self, tmp_path):
+        from mgwfbp_tpu.data.librispeech_fetch import fetch_librispeech
+
+        src = self._build_tar(self.UTTS[:1], tmp_path, wav=False)
+        out = str(tmp_path / "ds")
+        with pytest.raises(SystemExit, match="soundfile"):
+            fetch_librispeech(out, [src], split="train")
