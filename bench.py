@@ -59,24 +59,56 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def _devices_with_retry(attempts: int = 4):
+def _devices_with_retry(attempts: int = 4, init_timeout_s: float = 240.0):
     """jax.devices() with backoff — backend init can transiently fail
-    (UNAVAILABLE) if the chip/tunnel is briefly held."""
+    (UNAVAILABLE) if the chip/tunnel is briefly held.
+
+    Init also runs under a watchdog: a wedged remote chip makes the PJRT
+    client BLOCK INDEFINITELY inside make_c_api_client waiting for the
+    pool grant (observed: a killed client's server-side grant pinned the
+    chip for hours and every new client hung). A bench that hangs can
+    never print its one JSON line; timing out turns the outage into an
+    "error" payload instead.
+    """
+    import threading
+
     import jax
 
     delays = [5.0, 15.0, 30.0]
     last = None
     for i in range(attempts):
-        try:
-            return jax.devices()
-        except RuntimeError as e:  # "Unable to initialize backend ..."
-            last = e
+        box = {}
+
+        def init():
             try:
-                jax.extend.backend.clear_backends()
-            except Exception:
-                pass
-            if i < attempts - 1:
-                time.sleep(delays[min(i, len(delays) - 1)])
+                box["devices"] = jax.devices()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["error"] = e
+
+        t = threading.Thread(target=init, daemon=True)
+        t.start()
+        t.join(init_timeout_s)
+        if t.is_alive():
+            raise RuntimeError(
+                f"backend init timed out after {init_timeout_s:.0f}s — "
+                "chip/tunnel unavailable (client blocked waiting for the "
+                "device grant; a later retry may succeed once the pool "
+                "releases the stale grant)"
+            )
+        if "devices" in box:
+            return box["devices"]
+        last = box["error"]
+        if not isinstance(last, RuntimeError):
+            # only RuntimeError ("Unable to initialize backend", transient
+            # UNAVAILABLE) is worth retrying; config/import errors are
+            # deterministic — surface them immediately with their traceback
+            raise last
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:
+            pass
+        if i < attempts - 1:
+            time.sleep(delays[min(i, len(delays) - 1)])
     raise RuntimeError(f"backend init failed after {attempts} attempts: {last}")
 
 
