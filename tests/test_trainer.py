@@ -46,7 +46,7 @@ def test_trainer_policies_same_loss():
     # chaotically (exact per-application parity is pinned in
     # tests/test_allreduce.py).
     losses = {}
-    for policy in ("wfbp", "single", "none"):
+    for policy in ("wfbp", "single", "auto", "none"):
         cfg = _cfg(policy=policy, num_batches_per_epoch=5)
         t = Trainer(cfg, synthetic_data=True, profile_backward=False)
         m = t.train_epoch(0)

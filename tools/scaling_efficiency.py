@@ -39,7 +39,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-POLICIES = ("mgwfbp", "wfbp", "single")
+POLICIES = ("mgwfbp", "auto", "wfbp", "single")
 
 
 def _measure_step(model, meta, tx, mesh, reducer, batch, compute_dtype,
