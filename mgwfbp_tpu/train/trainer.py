@@ -646,6 +646,8 @@ class Trainer:
             self.carry = self._globalize(
                 self.model.initial_carry(self.process_batch), axes=0
             )
+        wd = getattr(self, "_watchdog", None)
+        wd_phase = f"train epoch {epoch}"
         for raw in loader:
             micro.append(self._to_model_batch(raw))
             if len(micro) < nsteps:
@@ -658,6 +660,8 @@ class Trainer:
                 )
             else:
                 self.state, metrics = self.train_step(self.state, batch)
+            if wd is not None:
+                wd.beat(wd_phase)
             self.iteration += 1
             window_iters += 1
             epoch_steps += 1
@@ -715,6 +719,7 @@ class Trainer:
         loader = self.bundle.val
         sums: dict[str, float] = {}
         wer_total, wer_n = 0.0, 0
+        wd = getattr(self, "_watchdog", None)
         # single-process ctc: decode inputs come OUT of the loss forward
         # (step.py per_device_ctc), so WER costs no second pass over the val
         # set; multi-host logits are not fully addressable on one process,
@@ -779,6 +784,8 @@ class Trainer:
                 # scalar PER BATCH to the host (a full RTT each through a
                 # tunneled chip); keep the adds async and pull once at the end
                 sums[k] = sums.get(k, 0.0) + v
+            if wd is not None:
+                wd.beat("evaluate")
         sums = {k: float(v) for k, v in sums.items()}
         count = sums.pop("count", 0.0)
         out = {k: v / max(count, 1.0) for k, v in sums.items()}
@@ -930,7 +937,25 @@ class Trainer:
             else cfg.max_epochs
         )
         metrics: dict = {}
-        for epoch in range(self.start_epoch, end):
+        # progress watchdog (failure detection, utils/watchdog.py): armed
+        # only when MGWFBP_WATCHDOG_S is set — a wedged device grant makes
+        # runtime calls block silently forever; this logs (and optionally
+        # aborts) instead
+        from mgwfbp_tpu.utils.watchdog import ProgressWatchdog
+
+        try:
+            with ProgressWatchdog() as wd:
+                self._watchdog = wd if wd.enabled else None
+                metrics = self._fit_epochs(range(self.start_epoch, end), cfg)
+        finally:
+            self._watchdog = None
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
+        return metrics
+
+    def _fit_epochs(self, epochs, cfg) -> dict:
+        metrics: dict = {}
+        for epoch in epochs:
             train_metrics = self.train_epoch(epoch)
             metrics = {"train": train_metrics}
             if self.writer is not None:
@@ -951,6 +976,4 @@ class Trainer:
                     self.writer.add_scalars("eval", eval_metrics, epoch)
             if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 self.save(epoch)
-        if self.checkpointer is not None:
-            self.checkpointer.wait()
         return metrics

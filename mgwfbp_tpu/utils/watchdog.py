@@ -1,0 +1,96 @@
+"""Training-progress watchdog: detect a hung step loop.
+
+Failure-detection parity (SURVEY.md §5): the reference's failure handling
+is passive (MPI aborts the world when a rank dies); a TPU client has a
+quieter failure mode — the runtime call BLOCKS forever when the device
+grant/tunnel wedges (observed in this container: a training process sat
+20+ minutes inside one eval dispatch at ~0% CPU with no error). The
+watchdog turns that silence into a signal: a daemon thread checks a
+monotonic heartbeat the step loop touches; if no progress lands within
+`timeout_s`, it logs CRITICAL with the stalled phase and (optionally,
+MGWFBP_WATCHDOG_ABORT=1) hard-exits so a supervisor can restart, instead
+of the job hanging until an external kill.
+
+Zero overhead on the hot path: the heartbeat is one time.monotonic()
+store per iteration, no locks (a torn read merely delays detection by one
+interval).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from mgwfbp_tpu.utils.logging import get_logger
+
+
+class ProgressWatchdog:
+    """Arm around a step loop; `beat(phase)` from the loop body."""
+
+    def __init__(
+        self,
+        timeout_s: Optional[float] = None,
+        abort: Optional[bool] = None,
+        check_interval_s: float = 10.0,
+    ):
+        env = os.environ.get("MGWFBP_WATCHDOG_S")
+        self.timeout_s = (
+            timeout_s
+            if timeout_s is not None
+            else (float(env) if env else 0.0)
+        )
+        self.abort = (
+            abort
+            if abort is not None
+            else os.environ.get("MGWFBP_WATCHDOG_ABORT") == "1"
+        )
+        self.check_interval_s = check_interval_s
+        self.log = get_logger("mgwfbp.watchdog")
+        self._last = time.monotonic()
+        self._phase = "startup"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    def beat(self, phase: str = "step") -> None:
+        self._phase = phase
+        self._last = time.monotonic()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(min(self.check_interval_s, self.timeout_s)):
+            idle = time.monotonic() - self._last
+            if idle > self.timeout_s:
+                self.fired = True
+                self.log.critical(
+                    "no training progress for %.0f s (stalled in %r; "
+                    "timeout %.0f s) — likely a wedged device/tunnel or "
+                    "blocked host call%s",
+                    idle, self._phase, self.timeout_s,
+                    "; aborting (MGWFBP_WATCHDOG_ABORT=1)"
+                    if self.abort
+                    else "",
+                )
+                if self.abort:
+                    # os._exit: the stalled runtime call cannot be
+                    # interrupted from Python — exiting the process is the
+                    # only way to hand control back to a supervisor
+                    os._exit(86)
+                self.beat(self._phase)  # re-arm so it warns periodically
+
+    def __enter__(self) -> "ProgressWatchdog":
+        if self.enabled:
+            self.beat("startup")
+            self._thread = threading.Thread(target=self._watch, daemon=True)
+            self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
