@@ -200,14 +200,22 @@ class HDF5ImageDataset:
     (reference datasets.py:8-36: train_img/train_labels/val_img/val_labels,
     swmr single-file). Indexable like ArrayDataset but reads on demand."""
 
-    def __init__(self, path: str, split: str = "train", num_classes: int = 1000):
+    def __init__(
+        self, path: str, split: str = "train", num_classes: Optional[int] = None
+    ):
         import h5py
 
         self._f = h5py.File(path, "r", libver="latest", swmr=True)
         key = "train" if split == "train" else "val"
         self.data = self._f[f"{key}_img"]
         self.labels = np.asarray(self._f[f"{key}_labels"], dtype=np.int32)
-        self.num_classes = num_classes
+        # the real corpus is 1000-class; smaller files (subset builds from
+        # imagenet_hdf5.py) carry their own label range
+        self.num_classes = (
+            num_classes
+            if num_classes is not None
+            else max(int(self.labels.max(initial=0)) + 1, 1)
+        )
 
     def __len__(self) -> int:
         return len(self.labels)

@@ -365,3 +365,35 @@ class TestPrefetchLoader:
 
         pf = PrefetchLoader(self._loader(), workers=0, device_put=False)
         assert len(list(pf)) == 8
+
+
+def test_imagenet_hdf5_builder_from_image_tree(tmp_path):
+    """Raw folder tree -> HDF5 builder (reference scripts/create_hdf5.py):
+    sorted-class mapping, resize to SxSx3 uint8, loader round-trip."""
+    from PIL import Image
+
+    from mgwfbp_tpu.data.datasets import load_imagenet_hdf5
+    from mgwfbp_tpu.data.imagenet_hdf5 import build_hdf5
+
+    raw = tmp_path / "raw"
+    rng = np.random.default_rng(0)
+    for split, per_class in (("train", 3), ("val", 1)):
+        for cls in ("n01berry", "n02dog"):
+            d = raw / split / cls
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                arr = rng.integers(0, 255, (37, 29, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"img{i}.png")
+    out = tmp_path / "built"
+    report = build_hdf5(str(raw), str(out), size=32)
+    assert report["num_classes"] == 2
+    assert report["train_images"] == 6 and report["val_images"] == 2
+    # mapping file: sorted class-dir order
+    rows = open(report["label_map"]).read().split()
+    assert rows[:2] == ["n01berry", "0"]
+    ds = load_imagenet_hdf5(str(out), "train")
+    assert ds is not None
+    assert ds.data.shape == (6, 32, 32, 3)
+    assert sorted(set(ds.labels.tolist())) == [0, 1]
+    val = load_imagenet_hdf5(str(out), "val")
+    assert len(val) == 2
