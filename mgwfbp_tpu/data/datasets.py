@@ -210,12 +210,17 @@ class HDF5ImageDataset:
         self.data = self._f[f"{key}_img"]
         self.labels = np.asarray(self._f[f"{key}_labels"], dtype=np.int32)
         # the real corpus is 1000-class; smaller files (subset builds from
-        # imagenet_hdf5.py) carry their own label range
-        self.num_classes = (
-            num_classes
-            if num_classes is not None
-            else max(int(self.labels.max(initial=0)) + 1, 1)
-        )
+        # imagenet_hdf5.py) carry their own label range. Infer over BOTH
+        # splits — a class present only in val must still fit the head, or
+        # out-of-range labels would silently corrupt eval metrics.
+        if num_classes is None:
+            num_classes = 1
+            for k in ("train_labels", "val_labels"):
+                if k in self._f:
+                    arr = np.asarray(self._f[k])
+                    if arr.size:
+                        num_classes = max(num_classes, int(arr.max()) + 1)
+        self.num_classes = num_classes
 
     def __len__(self) -> int:
         return len(self.labels)

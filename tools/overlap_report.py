@@ -166,6 +166,11 @@ def _build_setup(model_name, batch, policy, nsteps, comm_profile=None,
         jax.random.PRNGKey(0), model,
         jnp.zeros((1,) + tuple(meta.input_shape), meta.input_dtype), tx,
     )
+    threshold = 0
+    if policy.startswith("threshold:"):
+        # "threshold:N" rows reproduce the reference's static-threshold
+        # sweep (batch_dist_mpi.sh grid over element-count thresholds)
+        policy, threshold = "threshold", int(policy.split(":", 1)[1])
     reducer = None
     if policy not in ("none", "xla"):
         from mgwfbp_tpu.parallel.costmodel import resolve_profile
@@ -179,7 +184,7 @@ def _build_setup(model_name, batch, policy, nsteps, comm_profile=None,
             tb = measure_tb(model, meta, state.params, state.batch_stats, batch)
         reducer = make_merged_allreduce(
             state.params, axis_name=DATA_AXIS, policy=policy,
-            tb=tb, cost_model=cost,
+            tb=tb, cost_model=cost, threshold=threshold,
         )
     step = make_train_step(
         model, meta, tx, mesh, reducer, nsteps_update=nsteps, donate=False

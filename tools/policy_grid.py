@@ -23,7 +23,7 @@ POLICIES = ("mgwfbp", "auto", "wfbp", "single", "none")
 
 
 def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
-             rounds=5):
+             rounds=5, policies=POLICIES):
     """Interleaved A/B: build + warm every policy's step FIRST, then time
     them round-robin in `rounds` passes and keep each policy's best round.
 
@@ -60,7 +60,7 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
 
     runs = {}
     shared = None
-    for policy in POLICIES:
+    for policy in policies:
         mesh, model, meta, state, reducer, step, n_dev = _build_setup(
             model_name, batch, policy, nsteps, comm_profile, tb=tb
         )
@@ -87,7 +87,7 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
         }
     per_window = max(iters // rounds, 1)
     for _ in range(rounds):
-        for policy in POLICIES:
+        for policy in policies:
             r = runs[policy]
             s = r["state"]
             t0 = time.perf_counter()
@@ -97,7 +97,7 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
             r["windows"].append((time.perf_counter() - t0) / per_window)
             r["state"] = s
     results = {}
-    for policy in POLICIES:
+    for policy in policies:
         r = runs[policy]
         reducer = r["reducer"]
         dt = min(r["windows"])
@@ -134,7 +134,7 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
     # the quantity the schedule choice actually optimizes — predicted vs
     # measured, relative to the measured step.
     base = "wfbp"
-    scheduled = [p for p in POLICIES
+    scheduled = [p for p in policies
                  if results[p].get("predicted_total_s") is not None]
     if base in scheduled:
         checks = {}
@@ -182,6 +182,11 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--comm-profile", dest="comm_profile", default=None)
+    ap.add_argument("--thresholds", default=None,
+                    help="comma list of element-count thresholds, each run "
+                         "as an extra 'threshold:N' row ALONGSIDE the "
+                         "default policy set (the reference's "
+                         "batch_dist_mpi.sh static sweep)")
     ap.add_argument("--note", default=None,
                     help="environment context recorded into the artifact")
     ap.add_argument("--out", default=None)
@@ -189,9 +194,14 @@ def main(argv=None) -> int:
     from mgwfbp_tpu.utils.platform import apply_platform_overrides
 
     apply_platform_overrides()
+    policies = POLICIES
+    if args.thresholds:
+        policies = tuple(
+            f"threshold:{int(t)}" for t in args.thresholds.split(",")
+        ) + POLICIES
     report = run_grid(
         args.model, args.batch, args.nsteps, args.comm_profile,
-        args.iters, args.warmup,
+        args.iters, args.warmup, policies=policies,
     )
     if args.note:
         report["environment_note"] = args.note
