@@ -185,3 +185,28 @@ def test_family_profile_interp_pinned_against_held_out_extent():
     # measured trend: beta grows with P on this mesh (serialized thunks) —
     # the shape the constant-beta prior could never produce
     assert lo.beta < fam.entries[4].beta < hi.beta
+
+
+def test_reference_regime_simulation_auto_wins():
+    """profiles/reference_regime_sim.json pin: on the reference's own
+    measured cluster tables (56GbIB / 10GbE at its P=16 deployment scale),
+    the argmin 'auto' schedule must not lose to any baseline — the paper's
+    core claim, evaluated by the same simulate_groups the trainer runs."""
+    import json
+
+    d = json.load(
+        open(os.path.join(PROFILES, "reference_regime_sim.json"))
+    )
+    assert set(d["models"]) == {"resnet20", "resnet50", "vgg16"}
+    for m, md in d["models"].items():
+        for reg, r in md["regimes"].items():
+            t_auto = r["auto"]["predicted_total_ms"]
+            for pol in ("mgwfbp", "wfbp", "single"):
+                assert t_auto <= r[pol]["predicted_total_ms"] * 1.0001, (
+                    m, reg, pol
+                )
+            # the adaptive scan itself also beats both static baselines
+            assert r["mgwfbp"]["predicted_total_ms"] <= min(
+                r["wfbp"]["predicted_total_ms"],
+                r["single"]["predicted_total_ms"],
+            ) * 1.0001, (m, reg)
