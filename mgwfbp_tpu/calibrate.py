@@ -28,7 +28,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--no-gamma", action="store_true",
-                   help="skip the per-collective overhead (gamma) fit")
+                   help="skip the bucket-path microbenches: the "
+                        "per-collective overhead (gamma) fit AND the "
+                        "per-byte bucketization (pack_beta) fit — both "
+                        "save as 0.0, reverting the solver to the pure "
+                        "alpha-beta objective")
     p.add_argument("--no-overlap", action="store_true",
                    help="skip the comm/compute overlap-capability probe")
     p.add_argument("--gamma-total-log2", type=int, default=22,
@@ -55,6 +59,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         profile_allreduce,
         profile_group_overhead,
         profile_overlap_capability,
+        profile_pack_overhead,
     )
 
     import jax
@@ -74,6 +79,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         overlap = 1.0
         if not args.no_overlap:
             overlap = profile_overlap_capability(mesh)
+        pack_beta = 0.0
+        if not args.no_gamma:  # same bucket-path microbench family
+            pack_beta = profile_pack_overhead(mesh)
         # the sampled curve (not just the 2-parameter fit) is the persisted
         # predictor: one flat beta cannot describe payload-dependent
         # per-byte cost (cache regimes on CPU, DMA pipelining on TPU)
@@ -83,6 +91,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             ab=prof.model,
             gamma=gamma,
             overlap=overlap,
+            pack_beta=pack_beta,
         )
         return model, prof, gsamples
 
@@ -110,6 +119,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "beta_s_per_byte": model.beta,
                 "gamma_s": model.gamma,
                 "overlap": model.overlap,
+                "pack_beta_s_per_byte": model.pack_beta,
             }
         out_model = ProfileFamily(entries=entries)
         meta["world_sizes"] = extents
@@ -126,6 +136,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             "beta_s_per_byte": out_model.beta,
             "gamma_s": out_model.gamma,
             "overlap": out_model.overlap,
+            "pack_beta_s_per_byte": out_model.pack_beta,
             "samples": len(prof.sizes_bytes),
             "out": args.out,
         }

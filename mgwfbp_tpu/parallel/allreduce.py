@@ -345,6 +345,7 @@ def make_merged_allreduce(
                 layout.groups, sizes_b, tb, cost_model.predict,
                 float(getattr(cost_model, "gamma", 0.0)),
                 float(getattr(cost_model, "overlap", 1.0)),
+                float(getattr(cost_model, "pack_beta", 0.0)),
             )
             schedule = dataclasses.replace(
                 schedule,

@@ -51,6 +51,15 @@ class AlphaBeta:
     # reference model implicitly assumes 1.0 (NCCL streams); simulate_groups
     # blends its overlapped and serialized timelines by this factor.
     overlap: float = 1.0
+    # per-byte cost of bucketizing a MULTI-member group (flatten-concat
+    # before the collective + split-unpack after): a real copy for fused
+    # groups, ~free for singleton groups (a reshape the compiler folds).
+    # Grouping-DEPENDENT, so unlike beta it can flip schedule decisions:
+    # fusing two huge tensors saves one alpha+gamma but pays
+    # pack_beta * combined_bytes. The reference's model omits it (Horovod's
+    # fusion buffer pays the same copy invisibly). Calibrated by
+    # profiling.profile_pack_overhead.
+    pack_beta: float = 0.0
 
     def predict(self, nbytes) -> float:
         return self.alpha + self.beta * nbytes
@@ -83,6 +92,7 @@ class SampledCost:
     ab: AlphaBeta
     gamma: float = 0.0
     overlap: float = 1.0
+    pack_beta: float = 0.0
 
     def __post_init__(self):
         # predict() is the solver's inner-loop cost function (auto_groups
@@ -291,7 +301,7 @@ def interp_alpha_beta(
         scale = np.log2(nworkers) / np.log2(max(known[-1], 2))
         return AlphaBeta(
             alpha=base.alpha * scale, beta=base.beta, gamma=base.gamma,
-            overlap=base.overlap,
+            overlap=base.overlap, pack_beta=base.pack_beta,
         )
     # intermediate count: log2-interpolate between the bracketing entries
     lo = max(k for k in known if k < nworkers)
@@ -301,8 +311,10 @@ def interp_alpha_beta(
     b = table[lo].beta * (1 - t) + table[hi].beta * t
     g = table[lo].gamma * (1 - t) + table[hi].gamma * t
     ov = table[lo].overlap * (1 - t) + table[hi].overlap * t
+    pb = table[lo].pack_beta * (1 - t) + table[hi].pack_beta * t
     return AlphaBeta(
-        alpha=float(a), beta=float(b), gamma=float(g), overlap=float(ov)
+        alpha=float(a), beta=float(b), gamma=float(g), overlap=float(ov),
+        pack_beta=float(pb),
     )
 
 
@@ -328,7 +340,8 @@ class ProfileFamily:
         summaries = {
             k: (
                 dataclasses.replace(
-                    v.ab, gamma=v.gamma, overlap=v.overlap
+                    v.ab, gamma=v.gamma, overlap=v.overlap,
+                    pack_beta=v.pack_beta,
                 )
                 if isinstance(v, SampledCost)
                 else v
@@ -481,6 +494,11 @@ class TwoLevelAlphaBeta:
             return self.ici.overlap
         return min(self.ici.overlap, self.dcn.overlap)
 
+    @property
+    def pack_beta(self) -> float:
+        # the hier lowering packs each bucket once (on the ICI side)
+        return self.ici.pack_beta
+
 
 def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
     if isinstance(model, SampledCost):
@@ -491,6 +509,7 @@ def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
             "ab": dataclasses.asdict(model.ab),
             "gamma": model.gamma,
             "overlap": model.overlap,
+            "pack_beta": model.pack_beta,
         }
     return dataclasses.asdict(model)
 
@@ -503,6 +522,7 @@ def _model_from_dict(d: dict) -> "AlphaBeta | SampledCost":
             ab=AlphaBeta(**d["ab"]),
             gamma=d.get("gamma", 0.0),
             overlap=d.get("overlap", 1.0),
+            pack_beta=d.get("pack_beta", 0.0),
         )
     d = {k: v for k, v in d.items() if k != "kind"}
     return AlphaBeta(**d)

@@ -102,6 +102,7 @@ def simulate_groups(
     cost: CostFn,
     gamma: float = 0.0,
     overlap: float = 1.0,
+    pack_beta: float = 0.0,
 ) -> tuple[float, float, float]:
     """Simulate the backward/comm overlap timeline for a fixed grouping.
 
@@ -120,18 +121,29 @@ def simulate_groups(
     (bwd + all comm back-to-back — the virtual CPU mesh regime, where
     compute and collective thunks share the cores); intermediate values
     blend the two linearly.
+
+    `pack_beta` charges the bucketization copy (flatten-concat + unpack)
+    per byte of every MULTI-member group — singleton groups reduce their
+    tensor in place, so isolating a huge layer in its own group avoids its
+    pack copy entirely (costmodel.AlphaBeta.pack_beta; grouping-dependent,
+    hence part of the argmin objective).
     """
     ready = np.cumsum(np.asarray(tb, dtype=np.float64))
     bwd_end = float(ready[-1]) if len(ready) else 0.0
     link_free = 0.0
     comm_sum = 0.0
+    pack_bytes = 0.0
+    n_groups = 0
     for g in groups:
         gbytes = float(sum(sizes_bytes[i] for i in g))
         t = cost(gbytes)
         start = max(link_free, float(ready[max(g)]))
         link_free = start + t
         comm_sum += t
-    overhead = gamma * len(list(groups))
+        n_groups += 1
+        if len(g) > 1:
+            pack_bytes += gbytes
+    overhead = gamma * n_groups + pack_beta * pack_bytes
     total_hidden = max(bwd_end, link_free)
     total_serial = bwd_end + comm_sum
     ov = min(max(overlap, 0.0), 1.0)
@@ -254,6 +266,30 @@ def single_group(sizes: Sequence[int]) -> list[list[int]]:
     return [list(range(len(sizes)))] if len(sizes) else []
 
 
+def isolate_bigs_groups(
+    nbytes: Sequence[int], big_bytes: int
+) -> list[list[int]]:
+    """Singleton groups for layers over `big_bytes`; each contiguous run of
+    smaller layers fuses into one group. Rationale: a huge tensor pays
+    pack_beta * bytes to ride a fused bucket but ~nothing alone, while the
+    small layers between two bigs amortize alpha+gamma best as one bucket.
+    Neither the scan nor a cumulative threshold can produce this shape
+    (threshold packs a big layer together with its predecessors)."""
+    groups: list[list[int]] = []
+    run: list[int] = []
+    for i, b in enumerate(nbytes):
+        if b > big_bytes:
+            if run:
+                groups.append(run)
+                run = []
+            groups.append([i])
+        else:
+            run.append(i)
+    if run:
+        groups.append(run)
+    return groups
+
+
 def auto_groups(
     sizes: Sequence[int],
     tb: Sequence[float],
@@ -262,6 +298,7 @@ def auto_groups(
     itemsize: int | Sequence[int] = 4,
     gamma: float = 0.0,
     overlap: float = 1.0,
+    pack_beta: float = 0.0,
 ) -> tuple[list[list[int]], str]:
     """Simulate-and-argmin policy: evaluate every candidate schedule under
     the calibrated cost model (including gamma) and return the cheapest.
@@ -295,9 +332,24 @@ def auto_groups(
             seen_counts.add(len(groups))
             candidates.append((f"threshold:{th}", groups))
         th <<= 1
+    if pack_beta > 0.0:
+        # isolate-the-bigs shapes only pay off when bucketization has a
+        # per-byte price; sweep the "big" boundary geometrically
+        seen_shapes = {tuple(map(tuple, g)) for _, g in candidates}
+        bb = 1 << 10
+        max_b = max(nbytes)
+        while bb < max_b:
+            groups = isolate_bigs_groups(nbytes, bb)
+            key = tuple(map(tuple, groups))
+            if key not in seen_shapes:
+                seen_shapes.add(key)
+                candidates.append((f"isolate-bigs:{bb}", groups))
+            bb <<= 1
     best = None
     for detail, groups in candidates:
-        total, _, _ = simulate_groups(groups, nbytes, tb, cost, gamma, overlap)
+        total, _, _ = simulate_groups(
+            groups, nbytes, tb, cost, gamma, overlap, pack_beta
+        )
         if best is None or total < best[0]:
             best = (total, groups, detail)
     return best[1], best[2]
@@ -326,6 +378,9 @@ def build_schedule(
     overlap = (
         float(getattr(cost_model, "overlap", 1.0)) if cost_model else 1.0
     )
+    pack_beta = (
+        float(getattr(cost_model, "pack_beta", 0.0)) if cost_model else 0.0
+    )
 
     detail = ""
     if policy == "mgwfbp":
@@ -350,6 +405,7 @@ def build_schedule(
             itemsize=[l.itemsize for l in layers],
             gamma=gamma,
             overlap=overlap,
+            pack_beta=pack_beta,
         )
     elif policy == "threshold":
         groups = threshold_groups(sizes, threshold)
@@ -362,7 +418,7 @@ def build_schedule(
 
     if tb is not None and cost_model is not None and len(layers):
         total, nonoverlap, comm = simulate_groups(
-            groups, nbytes, tb, cost_model.predict, gamma, overlap
+            groups, nbytes, tb, cost_model.predict, gamma, overlap, pack_beta
         )
         group_times = predict_group_times(groups, nbytes, cost_model.predict)
     else:

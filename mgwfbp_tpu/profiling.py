@@ -152,6 +152,57 @@ def profile_group_overhead(
     return max(slope - alpha, 0.0), times
 
 
+def profile_pack_overhead(
+    mesh: Mesh,
+    total_elems: int = 1 << 22,
+    members: int = 32,
+    warmup: int = 3,
+    iters: int = 10,
+    axis_name: str = DATA_AXIS,
+    dtype=jnp.float32,
+) -> float:
+    """Measure pack_beta: the per-byte cost of bucketizing a MULTI-member
+    group (flatten-concat before the collective + split-unpack after).
+
+    Two programs with identical payload and collective count — one group of
+    ONE tensor (reduce in place, no copy) vs one group of `members` tensors
+    (real concat + split) — isolate the bucketization copy; the difference
+    divided by the payload bytes is pack_beta (costmodel.AlphaBeta.pack_beta).
+    """
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+
+    def timed(leaves):
+        reducer = make_merged_allreduce(
+            leaves,
+            axis_name=axis_name,
+            policy="single",
+            names=[f"g{i:04d}" for i in range(len(leaves))],
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                lambda t: reducer(t), mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        for _ in range(warmup):
+            jax.block_until_ready(fn(leaves))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(leaves)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    per = max(total_elems // members, 1)
+    # identical payload in both programs (per*members, not total_elems —
+    # a remainder would bill the mono baseline for bytes the packed run
+    # never reduces and bias pack_beta low)
+    t_mono = timed([jnp.ones((per * members,), dtype)])
+    t_packed = timed([jnp.ones((per,), dtype) for _ in range(members)])
+    nbytes = float(per * members * jnp.dtype(dtype).itemsize)
+    return max((t_packed - t_mono) / nbytes, 0.0)
+
+
 def profile_overlap_capability(
     mesh: Mesh,
     payload_elems: int = 1 << 22,

@@ -329,3 +329,47 @@ class TestGammaAndAuto:
             sizes, tb, alpha=0.0, cost=cost, gamma=3e-4, overlap=0.0
         )
         assert detail == "single"
+
+    def test_pack_beta_charges_multi_member_groups_only(self):
+        from mgwfbp_tpu.parallel.solver import simulate_groups
+
+        sizes_b = [1000, 1000, 4000]
+        tb = [1e-3] * 3
+        cost = linear_cost(0.0, 0.0)
+        # singleton groups: no pack cost at all
+        t_singles, _, _ = simulate_groups(
+            [[0], [1], [2]], sizes_b, tb, cost, pack_beta=1e-6
+        )
+        t_base, _, _ = simulate_groups([[0], [1], [2]], sizes_b, tb, cost)
+        assert t_singles == pytest.approx(t_base)
+        # fusing {0,1} pays pack_beta * 2000; fusing all pays * 6000
+        t_pair, _, _ = simulate_groups(
+            [[0, 1], [2]], sizes_b, tb, cost, pack_beta=1e-6
+        )
+        t_all, _, _ = simulate_groups(
+            [[0, 1, 2]], sizes_b, tb, cost, pack_beta=1e-6
+        )
+        assert t_pair - t_base == pytest.approx(2000e-6)
+        assert t_all - t_base == pytest.approx(6000e-6)
+
+    def test_isolate_bigs_candidate_shape_and_auto_pick(self):
+        from mgwfbp_tpu.parallel.solver import (
+            auto_groups, isolate_bigs_groups,
+        )
+
+        nbytes = [100, 100, 10_000, 100, 100, 10_000, 100]
+        assert isolate_bigs_groups(nbytes, 1000) == [
+            [0, 1], [2], [3, 4], [5], [6],
+        ]
+        # regime where isolating bigs is optimal: zero-overlap link, cheap
+        # wire, real gamma (fuse smalls) AND real pack cost (isolate bigs)
+        sizes = [25, 25, 2500, 25, 25, 2500, 25]  # elems (x4 bytes)
+        tb = [1e-3] * 7
+        groups, detail = auto_groups(
+            sizes, tb, alpha=0.0, cost=linear_cost(0.0, 1e-9),
+            gamma=1e-3, overlap=0.0, pack_beta=1e-6,
+        )
+        assert detail.startswith("isolate-bigs")
+        for g in groups:
+            if any(sizes[i] > 250 for i in g):
+                assert len(g) == 1  # bigs ride alone
