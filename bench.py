@@ -121,9 +121,27 @@ def _is_oom(e: Exception) -> bool:
     return "resource_exhausted" in s or "out of memory" in s or "oom" in s
 
 
+def _bench_cost_model(n_dev: int, platform: str):
+    """Committed calibration profile for this platform when one exists
+    (tpu_v5e_family on chip, cpu_family on the virtual mesh; override with
+    MGWFBP_BENCH_PROFILE), else the warned uncalibrated prior."""
+    from mgwfbp_tpu.parallel.costmodel import committed_profile_or_prior
+
+    default = (
+        "cpu_family.json" if platform == "cpu" else "tpu_v5e_family.json"
+    )
+    path = os.environ.get(
+        "MGWFBP_BENCH_PROFILE",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "profiles", default
+        ),
+    )
+    return committed_profile_or_prior(path, "ici", max(n_dev, 2))
+
+
 def _bench_policy(
     policy, make_state, model, meta, tx, mesh, batch_dict, tb, iters,
-    compute_dtype=None,
+    compute_dtype=None, cost_model=None,
 ):
     """Build the step for one policy, warm up, time with windowed host sync.
 
@@ -145,7 +163,11 @@ def _bench_policy(
             axis_name=DATA_AXIS,
             policy=policy,
             tb=tb if policy in ("mgwfbp", "auto") else None,
-            cost_model=lookup_alpha_beta("ici", max(n_dev, 2)),
+            cost_model=(
+                cost_model
+                if cost_model is not None
+                else lookup_alpha_beta("ici", max(n_dev, 2))
+            ),
             comm_op=os.environ.get("MGWFBP_BENCH_COMM_OP", "all_reduce"),
         )
     # donate=True: the state buffers are reused in place across steps —
@@ -236,6 +258,7 @@ def run_bench() -> dict:
 
     devices = _devices_with_retry()
     n_dev = len(devices)
+    cost_model, cost_src = _bench_cost_model(n_dev, devices[0].platform)
     mesh = make_mesh(MeshSpec(data=n_dev))
     model, meta = zoo.create_model(model_name)
     tx, _ = make_optimizer(
@@ -280,7 +303,7 @@ def run_bench() -> dict:
         for policy in _POLICIES:
             dt, groups, flops = _bench_policy(
                 policy, make_state, model, meta, tx, mesh, bd, tb_prof,
-                iters, compute_dtype=compute_dtype,
+                iters, compute_dtype=compute_dtype, cost_model=cost_model,
             )
             grid[policy] = {
                 "sec_per_iter": round(dt, 6),
@@ -305,8 +328,10 @@ def run_bench() -> dict:
     # skips the reducer entirely (reference single-path parity:
     # train_with_single never wraps the optimizer), which is exactly the
     # 'none' row; the instrumented mgwfbp row stays in `policies` so the
-    # no-op-dispatch overhead remains visible.
-    headline_policy = "none" if n_dev == 1 else "mgwfbp"
+    # no-op-dispatch overhead remains visible. Multi-device headline is
+    # `auto` — the production default policy (config.py) — matching the
+    # reference's ADAPTIVE_MERGE-on default.
+    headline_policy = "none" if n_dev == 1 else "auto"
     main = results[headline_policy]
     dt = main["sec_per_iter"]
     img_s = main["images_per_sec"]
@@ -337,6 +362,7 @@ def run_bench() -> dict:
             for k, v in results.items()
         },
         "tb_total_s": round(sum(tb), 6),
+        "cost_profile": cost_src or "UNCALIBRATED ici prior",
     }
     if mfu is not None:
         payload["mfu"] = round(mfu, 4)

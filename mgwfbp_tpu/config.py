@@ -36,7 +36,12 @@ class TrainConfig:
     # seq-parallel transformers need num_steps % seq_parallel == 0)
 
     # MG-WFBP scheduler
-    policy: str = "mgwfbp"  # mgwfbp | threshold | single | wfbp
+    policy: str = "auto"  # auto | mgwfbp | threshold | single | wfbp | none
+    # `auto` simulates every candidate schedule (wfbp/single/mgwfbp/threshold
+    # sweep/isolate-bigs) under the calibrated cost model and picks the argmin
+    # — the adaptive policy IS the product, matching the reference's
+    # ADAPTIVE_MERGE default (distributed_optimizer.py:267-270). `none` is the
+    # XLA-fusion oracle (no explicit bucketing).
     threshold: int = 0  # elements, for policy='threshold' (batch_dist_mpi.sh grid)
     connection: str = "ici"  # cost-model link class (settings.py CONNECTION)
     comm_profile: Optional[str] = None  # path to calibrated alpha-beta json

@@ -50,6 +50,22 @@ def preprocess_transcript(phrase: str) -> str:
     return phrase.strip().upper()
 
 
+def _conform_pcm(pcm: np.ndarray, rate: int) -> np.ndarray:
+    """s16 PCM at any rate/channels -> 16 kHz mono s16.
+
+    Downmix by channel mean; nearest-sample resample (sox's -r equivalent
+    in spirit; LibriSpeech is natively 16 kHz so the resample path is
+    rarely taken)."""
+    if pcm.ndim > 1:
+        pcm = pcm.mean(axis=1).astype(np.int16)
+    if rate != SAMPLE_RATE:
+        idx = np.round(
+            np.arange(0, len(pcm), rate / SAMPLE_RATE)
+        ).astype(np.int64)
+        pcm = pcm[np.minimum(idx, len(pcm) - 1)]
+    return pcm
+
+
 def _decode_flac(data: bytes) -> Optional[np.ndarray]:
     """FLAC -> int16 mono PCM at 16 kHz, or None when no decoder exists."""
     try:
@@ -59,25 +75,32 @@ def _decode_flac(data: bytes) -> Optional[np.ndarray]:
     except ImportError:
         return None
     pcm, rate = soundfile.read(io.BytesIO(data), dtype="int16")
-    if pcm.ndim > 1:
-        pcm = pcm.mean(axis=1).astype(np.int16)
-    if rate != SAMPLE_RATE:
-        # naive nearest-sample resample (sox's -r equivalent in spirit;
-        # LibriSpeech is natively 16 kHz so this path is rarely taken)
-        idx = np.round(
-            np.arange(0, len(pcm), rate / SAMPLE_RATE)
-        ).astype(np.int64)
-        pcm = pcm[np.minimum(idx, len(pcm) - 1)]
-    return pcm
+    return _conform_pcm(pcm, rate)
 
 
 def _audio_to_wav(name: str, data: bytes, wav_path: str) -> float:
     """Archive audio entry -> 16 kHz mono s16 wav; returns duration (s)."""
     if name.endswith(".wav"):
-        with open(wav_path, "wb") as f:
-            f.write(data)
-        with wave.open(wav_path) as w:
-            return w.getnframes() / w.getframerate()
+        # Never pass archive wavs through unchecked: a 44.1 kHz / stereo /
+        # 24-bit file would silently feed wrong-rate audio into the
+        # 16 kHz-mono feature pipeline (ADVICE r4 #2). Conform what we can
+        # (downmix, s16 cast, nearest-sample resample); reject the rest.
+        import io
+
+        with wave.open(io.BytesIO(data)) as w:
+            rate, channels, width = (
+                w.getframerate(), w.getnchannels(), w.getsampwidth()
+            )
+            frames = w.readframes(w.getnframes())
+        if width != 2:
+            raise SystemExit(
+                f"{name}: {8 * width}-bit wav; this pipeline expects s16 "
+                "PCM — pre-convert the archive audio to 16 kHz mono s16"
+            )
+        pcm = np.frombuffer(frames, dtype="<i2")
+        if channels > 1:
+            pcm = pcm.reshape(-1, channels)
+        return pcm_to_wav(_conform_pcm(pcm, rate), wav_path)
     if name.endswith(".flac"):
         pcm = _decode_flac(data)
         if pcm is None:

@@ -325,17 +325,20 @@ def auto_groups(
     ]
     total_elems = int(sum(sizes))
     th = 1 << 14
-    seen_counts = {len(g) for _, g in candidates}
+    # dedup by group SHAPE, not count — two thresholds can produce the same
+    # number of groups with different boundaries (e.g. sizes [5,5,5,5] at
+    # th=6 vs th=11), and those are distinct schedules the argmin must see
+    seen_shapes = {tuple(map(tuple, g)) for _, g in candidates}
     while th < total_elems:
         groups = threshold_groups(sizes, th)
-        if len(groups) not in seen_counts:
-            seen_counts.add(len(groups))
+        key = tuple(map(tuple, groups))
+        if key not in seen_shapes:
+            seen_shapes.add(key)
             candidates.append((f"threshold:{th}", groups))
         th <<= 1
     if pack_beta > 0.0:
         # isolate-the-bigs shapes only pay off when bucketization has a
         # per-byte price; sweep the "big" boundary geometrically
-        seen_shapes = {tuple(map(tuple, g)) for _, g in candidates}
         bb = 1 << 10
         max_b = max(nbytes)
         while bb < max_b:

@@ -144,6 +144,11 @@ class Trainer:
             self.tx,
         )
         self._tb_cache = None  # measured backward profile, reused on resize
+        # first-dispatch flags: the initial call of each step program
+        # compiles (long, silent); the watchdog gets an extended deadline
+        # for exactly that phase (ADVICE r4 #3)
+        self._train_step_compiled = False
+        self._eval_step_compiled = False
         self._profile_backward_enabled = profile_backward
         self.reducer = self._build_reducer(profile_backward)
         if self.reducer is not None:
@@ -235,6 +240,10 @@ class Trainer:
             step_model, self.meta, self.mesh, axis_name=self.data_axes,
             seq_axis=self.seq_axis, compute_dtype=self.compute_dtype,
         )
+        # fresh programs recompile on first dispatch (update_nworker
+        # rebuilds mid-run) — restore the watchdog's compile allowance
+        self._train_step_compiled = False
+        self._eval_step_compiled = False
 
     def _build_run_sinks(self) -> None:
         """(Re)bind every tag-addressed output — log file, checkpoint dir,
@@ -654,12 +663,21 @@ class Trainer:
                 continue
             batch = self._stack_micro(micro)
             micro = []
+            if wd is not None and not self._train_step_compiled:
+                # the first dispatch traces+compiles the step program — a
+                # legitimately long silent phase the per-step timeout must
+                # not hard-exit (ADVICE r4 #3)
+                from mgwfbp_tpu.utils.watchdog import COMPILE_ALLOW_S
+
+                wd.beat(f"compile train step (epoch {epoch})",
+                        allow_s=COMPILE_ALLOW_S)
             if self.meta.has_carry:
                 self.state, metrics, self.carry = self.train_step(
                     self.state, batch, self.carry
                 )
             else:
                 self.state, metrics = self.train_step(self.state, batch)
+            self._train_step_compiled = True
             if wd is not None:
                 wd.beat(wd_phase)
             self.iteration += 1
@@ -765,6 +783,10 @@ class Trainer:
             batch = self._globalize(
                 {k: jnp.asarray(v) for k, v in batch.items()}, axes=0
             )
+            if wd is not None and not self._eval_step_compiled:
+                from mgwfbp_tpu.utils.watchdog import COMPILE_ALLOW_S
+
+                wd.beat("compile eval step", allow_s=COMPILE_ALLOW_S)
             if self.meta.has_carry:
                 metrics, carry = self.eval_step(self.state, batch, carry)
             elif self.meta.task == "ctc":
@@ -779,6 +801,7 @@ class Trainer:
                     wer_n += n
             else:
                 metrics = self.eval_step(self.state, batch)
+            self._eval_step_compiled = True
             for k, v in metrics.items():
                 # device-side accumulation: a float() here would pull one
                 # scalar PER BATCH to the host (a full RTT each through a
@@ -975,5 +998,11 @@ class Trainer:
                 if self.writer is not None:
                     self.writer.add_scalars("eval", eval_metrics, epoch)
             if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                wd = getattr(self, "_watchdog", None)
+                if wd is not None:
+                    from mgwfbp_tpu.utils.watchdog import CHECKPOINT_ALLOW_S
+
+                    wd.beat(f"checkpoint epoch {epoch}",
+                            allow_s=CHECKPOINT_ALLOW_S)
                 self.save(epoch)
         return metrics

@@ -26,6 +26,13 @@ from typing import Optional
 from mgwfbp_tpu.utils.logging import get_logger
 
 
+# Extra deadline for known-long silent phases (overridable; seconds).
+# First XLA compile of a step program runs 20-40 s through the chip tunnel
+# and longer for big models; an orbax save streams the full state to disk.
+COMPILE_ALLOW_S = float(os.environ.get("MGWFBP_WATCHDOG_COMPILE_S", "600"))
+CHECKPOINT_ALLOW_S = float(os.environ.get("MGWFBP_WATCHDOG_CKPT_S", "180"))
+
+
 class ProgressWatchdog:
     """Arm around a step loop; `beat(phase)` from the loop body."""
 
@@ -50,6 +57,7 @@ class ProgressWatchdog:
         self.log = get_logger("mgwfbp.watchdog")
         self._last = time.monotonic()
         self._phase = "startup"
+        self._allow = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fired = False
@@ -58,14 +66,26 @@ class ProgressWatchdog:
     def enabled(self) -> bool:
         return self.timeout_s > 0
 
-    def beat(self, phase: str = "step") -> None:
+    def beat(self, phase: str = "step", allow_s: float = 0.0) -> None:
+        """Record progress. `allow_s` extends the deadline for the phase
+        being ENTERED — known-long silent phases (first-step XLA compile
+        through a tunnel ~20-40 s+, orbax checkpoint save) legitimately
+        outlast a per-step timeout, and hard-exiting a healthy run from
+        inside its first compile is worse than late detection (ADVICE r4
+        #3). The allowance applies until the next beat resets it."""
         self._phase = phase
+        # _last strictly before _allow: if the watcher wakes mid-beat it may
+        # see the fresh timestamp with the old (larger) allowance — one
+        # overly lenient check — instead of a stale timestamp with zero
+        # allowance, which would hard-exit a healthy run right as a long
+        # compile finishes
         self._last = time.monotonic()
+        self._allow = max(float(allow_s), 0.0)
 
     def _watch(self) -> None:
         while not self._stop.wait(min(self.check_interval_s, self.timeout_s)):
             idle = time.monotonic() - self._last
-            if idle > self.timeout_s:
+            if idle > self.timeout_s + self._allow:
                 self.fired = True
                 self.log.critical(
                     "no training progress for %.0f s (stalled in %r; "

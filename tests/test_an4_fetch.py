@@ -233,3 +233,40 @@ class TestLibriSpeechFetch:
         out = str(tmp_path / "ds")
         with pytest.raises(SystemExit, match="soundfile"):
             fetch_librispeech(out, [src], split="train")
+
+    def test_wav_entries_conformed_not_passed_through(self, tmp_path):
+        # ADVICE r4 #2: a 44.1 kHz stereo archive wav must come out as
+        # 16 kHz mono s16 (duration preserved), not be copied verbatim
+        # into the 16 kHz feature pipeline; 24-bit must error actionably.
+        import wave as _wave
+
+        from mgwfbp_tpu.data.librispeech_fetch import _audio_to_wav
+
+        rate, seconds = 44100, 1.0
+        n = int(rate * seconds)
+        t = np.arange(n) / rate
+        mono = (np.sin(2 * np.pi * 440 * t) * 8000).astype("<i2")
+        stereo = np.stack([mono, mono // 2], axis=1)
+        buf = io.BytesIO()
+        with _wave.open(buf, "wb") as w:
+            w.setnchannels(2)
+            w.setsampwidth(2)
+            w.setframerate(rate)
+            w.writeframes(stereo.tobytes())
+        out = str(tmp_path / "o.wav")
+        dur = _audio_to_wav("x.wav", buf.getvalue(), out)
+        assert dur == pytest.approx(seconds, rel=0.01)
+        with _wave.open(out) as w:
+            assert w.getframerate() == 16000
+            assert w.getnchannels() == 1
+            assert w.getsampwidth() == 2
+            assert w.getnframes() == pytest.approx(16000, rel=0.01)
+
+        buf24 = io.BytesIO()
+        with _wave.open(buf24, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(3)
+            w.setframerate(16000)
+            w.writeframes(b"\x00\x00\x00" * 100)
+        with pytest.raises(SystemExit, match="24-bit"):
+            _audio_to_wav("y.wav", buf24.getvalue(), out)

@@ -42,6 +42,20 @@ def test_fires_on_stall_and_stays_quiet_with_beats():
     assert any("train epoch 0" in m for m in msgs)
 
 
+def test_phase_allowance_defers_firing():
+    # ADVICE r4 #3: a beat entering a known-long phase (first-step compile,
+    # checkpoint save) extends the deadline by allow_s, so a timeout below
+    # compile time does not hard-exit a healthy run; the NEXT beat resets
+    # the allowance so ordinary steps keep the tight deadline.
+    with ProgressWatchdog(timeout_s=0.2, check_interval_s=0.05) as wd:
+        wd.beat("compile train step", allow_s=1.0)
+        time.sleep(0.5)  # longer than timeout, inside timeout+allowance
+        assert not wd.fired
+        wd.beat("train epoch 0")  # allowance resets
+        time.sleep(0.5)
+        assert wd.fired
+
+
 def test_trainer_arms_watchdog(monkeypatch):
     import numpy as np
 

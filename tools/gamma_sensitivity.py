@@ -1,0 +1,163 @@
+"""Gamma sensitivity of the auto-policy argmin (VERDICT r4 #7).
+
+gamma (per-collective pack/dispatch overhead) is the worst-calibrated term
+in the cost model — held-out interpolation error at P=4 was 26.8% vs 7.5%
+for beta (profiles/family_interp_check.json) — and it both gates the scan's
+merge rule (c) and scales linearly with group count in every simulation.
+This tool quantifies what that residual error does to the DECISION: for
+each grid model it re-runs the auto argmin with gamma scaled x{0.7, 1.0,
+1.3} (the held-out error band) and reports whether the chosen schedule
+flips, and what the flip costs under the unscaled model.
+
+A flip with near-zero regret means the argmin sits on a plateau (two
+schedules within noise of each other) — harmless. A flip with material
+regret would mean gamma calibration quality limits auto's wins.
+
+Usage:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python tools/gamma_sensitivity.py --models resnet20,resnet56,vgg16 \
+    --comm-profile profiles/cpu_family.json --out profiles/gamma_sensitivity.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCALES = (0.7, 1.0, 1.3)
+
+
+def analyze_model(model_name, batch, comm_profile, scales=SCALES):
+    import jax
+    import jax.numpy as jnp
+
+    from overlap_report import measure_tb
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.optim import make_optimizer
+    from mgwfbp_tpu.parallel.allreduce import arrival_order
+    from mgwfbp_tpu.parallel.costmodel import load_profile, resolve_profile
+    from mgwfbp_tpu.parallel.solver import auto_groups, simulate_groups
+    from mgwfbp_tpu.train import create_train_state
+
+    n_dev = len(jax.devices())
+    model, meta = zoo.create_model(model_name)
+    tx, _ = make_optimizer(
+        0.1, momentum=0.9, weight_decay=1e-4, lr_schedule="const",
+        dataset=meta.dataset, num_batches_per_epoch=1,
+    )
+    state = create_train_state(
+        jax.random.PRNGKey(0), model,
+        jnp.zeros((1,) + tuple(meta.input_shape), meta.input_dtype), tx,
+    )
+    tb = measure_tb(model, meta, state.params, state.batch_stats, batch)
+    leaves = jax.tree_util.tree_leaves(state.params)
+    paths = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    names = [jax.tree_util.keystr(kp) for kp, _ in paths]
+    perm = arrival_order(len(names), names=names)
+    sizes = [int(leaves[i].size) for i in perm]
+    itemsizes = [int(leaves[i].dtype.itemsize) for i in perm]
+    nbytes = [s * it for s, it in zip(sizes, itemsizes)]
+
+    cost = resolve_profile(load_profile(comm_profile), max(n_dev, 2))
+    gamma0 = float(getattr(cost, "gamma", 0.0))
+    overlap = float(getattr(cost, "overlap", 1.0))
+    pack_beta = float(getattr(cost, "pack_beta", 0.0))
+
+    rows = {}
+    choices = {}
+    for s in scales:
+        g = gamma0 * s
+        groups, detail = auto_groups(
+            sizes, tb, alpha=cost.alpha, cost=cost.predict,
+            itemsize=itemsizes, gamma=g, overlap=overlap,
+            pack_beta=pack_beta,
+        )
+        # regret: how much worse this choice is than the unscaled-model
+        # optimum, PRICED UNDER THE UNSCALED MODEL (if the true gamma is
+        # gamma0 but we calibrated gamma0*s, we pick `groups` and pay this)
+        t_at_nominal, _, _ = simulate_groups(
+            groups, nbytes, tb, cost.predict, gamma0, overlap, pack_beta
+        )
+        rows[str(s)] = {
+            "gamma": g,
+            "chosen": detail,
+            "num_groups": len(groups),
+            "group_shape_hash": hash(tuple(map(tuple, groups))) & 0xFFFFFFFF,
+            "time_under_nominal_gamma_s": round(t_at_nominal, 6),
+            "_groups": groups,
+        }
+        choices[str(s)] = tuple(map(tuple, groups))
+    nominal = rows["1.0"]
+    t_opt = nominal["time_under_nominal_gamma_s"]
+    for s in scales:
+        r = rows[str(s)]
+        r["regret_vs_nominal_s"] = round(
+            r["time_under_nominal_gamma_s"] - t_opt, 6
+        )
+        r["regret_frac"] = round(
+            (r["time_under_nominal_gamma_s"] - t_opt) / max(t_opt, 1e-12), 5
+        )
+        del r["_groups"]
+    flips = sorted(
+        {s for s in map(str, scales) if choices[s] != choices["1.0"]}
+    )
+    return {
+        "model": model_name,
+        "batch_per_device": batch,
+        "n_devices": n_dev,
+        "gamma_nominal": gamma0,
+        "overlap": overlap,
+        "pack_beta": pack_beta,
+        "tb_total_s": round(sum(tb), 6),
+        "by_scale": rows,
+        "schedule_flips_at": flips,
+        "max_regret_frac": max(r["regret_frac"] for r in rows.values()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--models", default="resnet20,resnet56,vgg16")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--comm-profile", dest="comm_profile",
+                    default="profiles/cpu_family.json")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    models = [m.strip() for m in args.models.split(",") if m.strip()]
+    per_model = {m: analyze_model(m, args.batch, args.comm_profile)
+                 for m in models}
+    worst = max(r["max_regret_frac"] for r in per_model.values())
+    report = {
+        "what": (
+            "auto-policy argmin re-run with gamma x{0.7,1.0,1.3} (the "
+            "held-out calibration error band, family_interp_check.json); "
+            "a 'flip' is a different chosen schedule, its regret is the "
+            "extra time paid under the NOMINAL gamma"
+        ),
+        "scales": list(SCALES),
+        "comm_profile": args.comm_profile,
+        "models": per_model,
+        "conclusion": {
+            "max_regret_frac_any_model_any_scale": worst,
+            "gamma_error_band_is_decision_safe": bool(worst < 0.02),
+        },
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
