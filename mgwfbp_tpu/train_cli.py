@@ -131,9 +131,18 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.print_config:
         print(json.dumps(cfg.__dict__, indent=2, default=str))
         return 0
-    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+    from mgwfbp_tpu.utils.platform import (
+        apply_platform_overrides, preflight_backend,
+    )
 
     apply_platform_overrides()
+    if not (args.coordinator or args.num_processes):
+        # fail fast on a wedged device grant instead of hanging in PJRT
+        # init (MGWFBP_INIT_TIMEOUT_S tunes/disables). Single-process
+        # only: jax.distributed.initialize() must run before any backend
+        # touch, so multi-host launches skip the probe — there the
+        # coordinator barrier itself surfaces a dead host.
+        preflight_backend()
     from mgwfbp_tpu.parallel.mesh import init_distributed
     from mgwfbp_tpu.train.trainer import Trainer
 

@@ -65,3 +65,47 @@ def already_initialized_platforms() -> list[str]:
         return sorted(getattr(xla_bridge, "_backends", {}) or {})
     except Exception:
         return []
+
+
+def preflight_backend(timeout_s: Optional[float] = None) -> list:
+    """Initialize the JAX backend under a deadline; raise instead of hang.
+
+    A wedged device grant makes PJRT init BLOCK INDEFINITELY inside
+    make_c_api_client (observed on the tunneled chip: a killed client's
+    stale server-side grant pinned the device for hours and every new
+    client hung silently). A launcher that hangs can neither report nor
+    retry; failing fast with an actionable error is the recovery seam
+    (failure-detection parity, SURVEY.md §5).
+
+    timeout_s: None reads MGWFBP_INIT_TIMEOUT_S (default 300); <= 0
+    disables the deadline. Returns jax.devices() on success.
+    """
+    import threading
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("MGWFBP_INIT_TIMEOUT_S", "300"))
+    import jax
+
+    if timeout_s <= 0:
+        return jax.devices()
+    box: dict = {}
+
+    def init():
+        try:
+            box["devices"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise RuntimeError(
+            f"JAX backend init exceeded {timeout_s:.0f}s — device/tunnel "
+            "unavailable (client blocked waiting for the device grant). "
+            "Retry later, probe with `timeout 60 python -c 'import jax; "
+            "jax.devices()'`, or raise MGWFBP_INIT_TIMEOUT_S."
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["devices"]

@@ -71,3 +71,22 @@ def test_trainer_arms_watchdog(monkeypatch):
     m = t.fit(1)
     assert np.isfinite(m["train"]["loss"])
     assert t._watchdog is None  # disarmed after fit
+
+
+def test_preflight_backend_returns_devices_and_times_out(monkeypatch):
+    """Failure-detection seam for the launcher: backend init under a
+    deadline raises an actionable error instead of blocking forever on a
+    wedged device grant."""
+    import jax
+
+    from mgwfbp_tpu.utils.platform import preflight_backend
+
+    assert len(preflight_backend(timeout_s=60)) >= 1  # healthy backend
+    assert len(preflight_backend(timeout_s=0)) >= 1  # deadline disabled
+
+    def hang():
+        time.sleep(30)
+
+    monkeypatch.setattr(jax, "devices", hang)
+    with pytest.raises(RuntimeError, match="device grant"):
+        preflight_backend(timeout_s=0.2)

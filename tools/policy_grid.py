@@ -193,13 +193,21 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
             runs["single"]["windows"][i] - runs["single#control"]["windows"][i]
             for i in range(len(runs["single"]["windows"]))
         ]
-        bound = max(abs(d) for d in nd)
+        med_abs = _st.median([abs(d) for d in nd])
+        # robust bound: a single stalled round can blow the max |delta| to
+        # >10x the typical round (observed 0.38 s vs 0.014 s median on an
+        # idle host), which would mark EVERY comparison "inside noise".
+        # The bound a policy's MEDIAN paired delta must clear is therefore
+        # 3x the noise pair's median |delta| (max still reported).
+        bound = 3.0 * med_abs
         noise = {
             "pair": ["single", "single#control"],
             "per_round_delta_s": [round(d, 6) for d in nd],
-            "median_abs_delta_s": round(_st.median([abs(d) for d in nd]), 6),
-            "max_abs_delta_s": round(bound, 6),
-            "max_abs_delta_frac_of_step": round(
+            "median_abs_delta_s": round(med_abs, 6),
+            "max_abs_delta_s": round(max(abs(d) for d in nd), 6),
+            "bound_s": round(bound, 6),
+            "bound_rule": "3 * median |noise delta| (robust to stalled rounds)",
+            "bound_frac_of_step": round(
                 bound / min(med["single"], med["single#control"]), 4
             ),
         }
@@ -224,7 +232,7 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
             "median_delta_frac_of_step": round(md / med[best], 4),
         }
         if noise is not None:
-            outside = abs(md) > noise["max_abs_delta_s"]
+            outside = abs(md) > noise["bound_s"]
             entry["outside_noise"] = outside
             (beats if outside else ties).append(p)
         comparisons[f"{p}-vs-{best}"] = entry
@@ -238,8 +246,8 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
         conclusion["note"] = (
             f"'{best}' is fastest by median-of-rounds; rows in "
             "ties_within_noise are statistically indistinguishable from it "
-            "(their median paired delta is inside the identical-program "
-            "noise pair's max |delta|)."
+            "(their median paired delta is inside 3x the identical-program "
+            "noise pair's median |delta|)."
         )
 
     return {
