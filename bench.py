@@ -35,28 +35,14 @@ import time
 
 P100_RESNET50_IMG_S = 250.0
 
-# Peak dense-matmul FLOP/s per chip by device-kind substring (bf16 for TPU
-# generations — matches the bench's default bf16 compute dtype; for fp32
-# runs it is an upper bound, making MFU conservative. Tiny nominal value
-# keeps MFU meaningful in CPU smoke runs).
-_PEAK_FLOPS = [
-    ("v5 lite", 197e12),  # TPU v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v4", 275e12),
-    ("v6", 918e12),  # Trillium
-    ("cpu", 1e11),
-]
-
 _POLICIES = ("mgwfbp", "auto", "wfbp", "single", "none")
 
 
-def _peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+def _peak_flops(device_kind: str):
+    """Device-kind-keyed peak FLOP/s (shared table in utils.platform)."""
+    from mgwfbp_tpu.utils.platform import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def _devices_with_retry(attempts: int = 4, init_timeout_s: float = 240.0):
@@ -70,34 +56,26 @@ def _devices_with_retry(attempts: int = 4, init_timeout_s: float = 240.0):
     never print its one JSON line; timing out turns the outage into an
     "error" payload instead.
     """
-    import threading
-
     import jax
+
+    from mgwfbp_tpu.utils.platform import DeadlineExceeded, run_with_deadline
 
     delays = [5.0, 15.0, 30.0]
     last = None
     for i in range(attempts):
-        box = {}
-
-        def init():
-            try:
-                box["devices"] = jax.devices()
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                box["error"] = e
-
-        t = threading.Thread(target=init, daemon=True)
-        t.start()
-        t.join(init_timeout_s)
-        if t.is_alive():
+        try:
+            return run_with_deadline(
+                jax.devices, init_timeout_s, what="backend init"
+            )
+        except DeadlineExceeded:
             raise RuntimeError(
                 f"backend init timed out after {init_timeout_s:.0f}s — "
                 "chip/tunnel unavailable (client blocked waiting for the "
                 "device grant; a later retry may succeed once the pool "
                 "releases the stale grant)"
-            )
-        if "devices" in box:
-            return box["devices"]
-        last = box["error"]
+            ) from None
+        except Exception as e:  # noqa: BLE001 — filtered below
+            last = e
         if not isinstance(last, RuntimeError):
             # only RuntimeError ("Unable to initialize backend", transient
             # UNAVAILABLE) is worth retrying; config/import errors are
@@ -114,6 +92,69 @@ def _devices_with_retry(attempts: int = 4, init_timeout_s: float = 240.0):
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+def _progress(msg: str) -> None:
+    """Phase marker on stderr (stdout carries exactly one JSON line).
+
+    The r5 chip outage wedged mid-run with nothing between the init
+    warning and the driver's timeout — phase markers make the next wedge
+    diagnosable from the stderr tail alone."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _compute_preflight(
+    attempts: int = 2, deadline_s: float = 180.0
+) -> None:
+    """Fail fast when the device accepts a session but executes nothing.
+
+    Observed r5 outage mode (distinct from the r4 init wedge): jax.devices()
+    returns instantly, then the FIRST real computation — even a 128x128
+    matmul — blocks forever server-side. A bench that only guards init
+    (_devices_with_retry) then hangs until the driver's timeout with no
+    JSON line. This runs one trivial jitted program under a deadline with
+    backoff retries, so a wedged-compute outage becomes an "error" payload
+    in minutes. MGWFBP_BENCH_PREFLIGHT_S overrides the deadline; 0 skips.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mgwfbp_tpu.utils.platform import DeadlineExceeded, run_with_deadline
+
+    deadline_s = float(
+        os.environ.get("MGWFBP_BENCH_PREFLIGHT_S", str(deadline_s))
+    )
+    if deadline_s <= 0:
+        return
+
+    def probe():
+        x = jnp.ones((128, 128), jnp.float32)
+        return float(jax.jit(lambda a: (a @ a).sum())(x))
+
+    # ONE retry only: PJRT is thread-safe, so a fresh probe thread can
+    # succeed after a transient tunnel hiccup — but in the hard wedge mode
+    # (device executes nothing) every attempt burns a full deadline, and
+    # run_with_deadline's contract says a timed-out process is tainted.
+    # Two attempts bound time-to-error at ~2*deadline while still covering
+    # the transient case.
+    delays = [20.0, 60.0]
+    for i in range(attempts):
+        try:
+            run_with_deadline(probe, deadline_s, what="compute preflight")
+            return
+        except DeadlineExceeded as e:
+            # only the hang is worth retrying; anything else (OOM, bad
+            # flag, config error) is deterministic — propagate it intact
+            msg = (
+                f"compute preflight timed out after {deadline_s:.0f}s — "
+                "device executes nothing though backend init succeeded "
+                "(wedged grant/tunnel; a later retry may succeed)"
+            )
+            _progress(f"preflight attempt {i + 1}/{attempts}: {msg}")
+            if i == attempts - 1:
+                raise RuntimeError(msg) from e
+            time.sleep(delays[min(i, len(delays) - 1)])
 
 
 def _is_oom(e: Exception) -> bool:
@@ -257,6 +298,9 @@ def run_bench() -> dict:
     )
 
     devices = _devices_with_retry()
+    _progress(f"backend up: {devices}")
+    _compute_preflight()
+    _progress("compute preflight ok")
     n_dev = len(devices)
     cost_model, cost_src = _bench_cost_model(n_dev, devices[0].platform)
     mesh = make_mesh(MeshSpec(data=n_dev))
@@ -288,6 +332,7 @@ def run_bench() -> dict:
         """tb measurement + full policy grid at ONE batch size — the A/B
         grid must never mix batch sizes, and the mgwfbp schedule must come
         from a tb profile measured at the batch it is timed at."""
+        _progress(f"materializing batch (per-device {per_dev})")
         gb, bd = make_batch(per_dev)
         paths = jax.tree_util.tree_flatten_with_path(state.params)[0]
         names = [jax.tree_util.keystr(kp) for kp, _ in paths]
@@ -295,12 +340,14 @@ def run_bench() -> dict:
         micro = {"x": bd["x"][0, :per_dev], "y": bd["y"][0, :per_dev]}
         # measured tb: real backward wall clock (scale measured, not
         # invented — VERDICT r2 Weak #4); trace-attributed when possible
+        _progress(f"tb backward profiling (batch {per_dev})")
         tb_prof = benchmark_trainer_backward(
             model, meta, state.params, state.batch_stats, micro, perm,
             warmup=2, iters=5, names=names, compute_dtype=compute_dtype,
         )
         grid: dict[str, dict] = {}
         for policy in _POLICIES:
+            _progress(f"policy {policy}: build + compile + time")
             dt, groups, flops = _bench_policy(
                 policy, make_state, model, meta, tx, mesh, bd, tb_prof,
                 iters, compute_dtype=compute_dtype, cost_model=cost_model,

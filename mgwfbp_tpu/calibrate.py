@@ -42,7 +42,24 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "2,4,8): produces a 'family' profile whose per-P "
                         "alpha-beta-gamma replace the invented alpha-vs-hops "
                         "prior with measured trend")
+    p.add_argument("--prior-extend", default=None, metavar="CONN",
+                   help="single-chip mode (VERDICT r4 #5): calibrate the "
+                        "chip-measurable constants at the available world "
+                        "size (gamma = dispatch per extra collective, "
+                        "pack_beta = bucketization copy, overlap — all real "
+                        "at world 1, where the collective itself is "
+                        "identity) and emit a FAMILY profile whose larger "
+                        "extents carry the named alpha-beta prior ('ici' / "
+                        "'dcn') combined with the measured "
+                        "gamma/pack_beta/overlap. Meta separates "
+                        "measured_fields from prior_fields per entry.")
+    p.add_argument("--prior-world-sizes", default="2,4,8,16",
+                   help="extents for the prior-extended entries")
     args = p.parse_args(argv)
+    if args.prior_extend and args.world_sizes:
+        p.error("--prior-extend and --world-sizes are mutually exclusive: "
+                "the former measures ONE world size and prior-fills the "
+                "rest, the latter measures each listed extent")
 
     from mgwfbp_tpu.utils.platform import apply_platform_overrides
 
@@ -101,7 +118,54 @@ def main(argv: Optional[list[str]] = None) -> int:
         "payload_log2_range": [args.min_log2, args.max_log2],
         "iters": args.iters,
     }
-    if args.world_sizes:
+    if args.prior_extend:
+        from mgwfbp_tpu.parallel.costmodel import (
+            AlphaBeta,
+            lookup_alpha_beta,
+        )
+
+        avail = len(jax.devices())
+        mesh = make_mesh(MeshSpec(data=avail), devices=jax.devices())
+        measured, _, gamma_samples = calibrate_mesh(mesh)
+        prior_sizes = sorted(
+            {int(s) for s in args.prior_world_sizes.split(",")} - {avail}
+        )
+        entries: dict = {avail: measured}
+        for n in prior_sizes:
+            ab = lookup_alpha_beta(args.prior_extend, n)
+            entries[n] = AlphaBeta(
+                alpha=ab.alpha, beta=ab.beta, gamma=measured.gamma,
+                overlap=measured.overlap, pack_beta=measured.pack_beta,
+            )
+        out_model = ProfileFamily(entries=entries)
+        meta["measured_fields"] = {
+            str(avail): "all (sampled curve + gamma + pack_beta + overlap)",
+            **{
+                str(n): "gamma, pack_beta, overlap (chip-measured at "
+                        f"world={avail})"
+                for n in prior_sizes
+            },
+        }
+        meta["prior_fields"] = {
+            str(n): f"alpha, beta ({args.prior_extend} prior — no "
+                    "multi-chip fabric available to measure)"
+            for n in prior_sizes
+        }
+        if gamma_samples:
+            meta["gamma_samples_s"] = [
+                [k, round(t, 6)] for k, t in gamma_samples
+            ]
+        report = {
+            "measured_world": avail,
+            "alpha_s": measured.alpha,
+            "beta_s_per_byte": measured.beta,
+            "gamma_s": measured.gamma,
+            "overlap": measured.overlap,
+            "pack_beta_s_per_byte": measured.pack_beta,
+            "prior_extended": prior_sizes,
+            "out": args.out,
+        }
+    elif args.world_sizes:
         extents = sorted({int(s) for s in args.world_sizes.split(",")})
         avail = len(jax.devices())
         entries = {}

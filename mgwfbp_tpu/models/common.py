@@ -25,20 +25,25 @@ conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_n
 dense_kernel_init = nn.initializers.lecun_normal()
 
 
-def bn_dtype():
-    """BatchNorm computation-dtype override.
+def bn_kwargs() -> dict:
+    """BatchNorm computation-dtype override, as constructor kwargs.
 
     flax keeps batch-statistics reductions in float32 regardless of the
-    mixed-precision policy — the numerically safe default. On an
+    mixed-precision policy — the numerically safe default, enforced by
+    BOTH the module dtype and `force_float32_reductions=True` (flax
+    promotes the stats reduction to f32 even when dtype is bf16). On an
     HBM-bound model those f32 reduce passes are measurable traffic
     (~5.5% of resnet50's device time in the r4 roofline);
-    MGWFBP_BN_DTYPE=bfloat16 runs them in bf16 so the cut can be
-    MEASURED against the step time (the MFU ablation knob). Default
-    None keeps f32 stats."""
+    MGWFBP_BN_DTYPE=bfloat16 sets dtype AND drops the forced promotion
+    so the reduce passes really run in bf16 and the cut can be MEASURED
+    against the step time (the MFU ablation knob). Default: empty, flax's
+    safe f32 stats."""
     import os
 
     s = os.environ.get("MGWFBP_BN_DTYPE")
-    return jnp.dtype(s) if s else None
+    if not s:
+        return {}
+    return {"dtype": jnp.dtype(s), "force_float32_reductions": False}
 
 
 class ConvBN(nn.Module):
@@ -69,7 +74,7 @@ class ConvBN(nn.Module):
         )(x)
         x = nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=bn_dtype(),
+            **bn_kwargs(),
         )(x)
         if self.use_relu:
             x = nn.relu(x)

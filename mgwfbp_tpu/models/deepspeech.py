@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from mgwfbp_tpu.models.common import bn_dtype
+from mgwfbp_tpu.models.common import bn_kwargs
 
 
 def hardtanh_0_20(x: jax.Array) -> jax.Array:
@@ -57,7 +57,7 @@ class MaskConv(nn.Module):
                 features, (kt, kf), (st, sf),
                 padding=((pt, pt), (pf, pf)), use_bias=False,
             )(x)
-            x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x)
             x = hardtanh_0_20(x)
             lengths = conv_out_length(lengths, kt, st, pt)
             mask = length_mask(lengths, x.shape[1])
@@ -83,7 +83,7 @@ class BatchRNN(nn.Module):
             # SequenceWise BN: normalize over (B*T) per feature
             # (reference lstm_models.py:21-42)
             b, t, h = x.shape
-            x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(
                 x.reshape(b * t, h)
             ).reshape(b, t, h)
         fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="fwd")
@@ -158,7 +158,7 @@ class DeepSpeech(nn.Module):
         if not self.bidirectional:
             x = Lookahead()(x)
         bb, tt, hh = x.shape
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(
             x.reshape(bb * tt, hh)
         ).reshape(bb, tt, hh)
         logits = nn.Dense(self.num_classes, use_bias=False)(x)

@@ -17,7 +17,7 @@ from flax import linen as nn
 
 from mgwfbp_tpu.models.common import (
     avg_pool,
-    bn_dtype,
+    bn_kwargs,
     classifier_head,
     conv_kernel_init,
     global_avg_pool,
@@ -32,11 +32,11 @@ class DenseLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x)
         y = nn.relu(y)
         y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
                     kernel_init=conv_kernel_init)(y)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(y)
         y = nn.relu(y)
         y = nn.Conv(self.growth_rate, (3, 3), padding="SAME", use_bias=False,
                     kernel_init=conv_kernel_init)(y)
@@ -50,7 +50,7 @@ class Transition(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x)
         x = nn.relu(x)
         x = nn.Conv(self.features, (1, 1), use_bias=False,
                     kernel_init=conv_kernel_init)(x)
@@ -70,7 +70,7 @@ class DenseNet(nn.Module):
         if self.imagenet_stem:
             x = nn.Conv(self.num_init_features, (7, 7), (2, 2), padding="SAME",
                         use_bias=False, kernel_init=conv_kernel_init)(x)
-            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x))
+            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x))
             x = max_pool(x, (3, 3), (2, 2), padding="SAME")
         else:
             x = nn.Conv(self.num_init_features, (3, 3), padding="SAME",
@@ -80,7 +80,7 @@ class DenseNet(nn.Module):
                 x = DenseLayer(self.growth_rate)(x, train)
             if bi != len(self.block_config) - 1:
                 x = Transition(int(x.shape[-1] * self.compression))(x, train)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x))
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x))
         x = global_avg_pool(x)
         return classifier_head(x, self.num_classes)
 

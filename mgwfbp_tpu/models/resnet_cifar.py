@@ -22,7 +22,7 @@ from flax import linen as nn
 from mgwfbp_tpu.models.common import (
     BasicBlock,
     ConvBN,
-    bn_dtype,
+    bn_kwargs,
     classifier_head,
     conv_kernel_init,
     global_avg_pool,
@@ -39,7 +39,7 @@ class PreActBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         pre = nn.relu(
-            nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x)
+            nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x)
         )
         needs_proj = x.shape[-1] != self.features or self.strides != 1
         residual = (
@@ -54,7 +54,7 @@ class PreActBlock(nn.Module):
             self.features, (3, 3), (self.strides, self.strides),
             use_bias=False, kernel_init=conv_kernel_init,
         )(pre)
-        y = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(y))
+        y = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(y))
         y = nn.Conv(self.features, (3, 3), use_bias=False, kernel_init=conv_kernel_init)(y)
         return y + residual
 
@@ -85,7 +85,7 @@ class CifarResNet(nn.Module):
                 strides = 2 if (stage > 0 and i == 0) else 1
                 x = block(width, strides)(x, train)
         if self.preact:
-            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=bn_dtype())(x))
+            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, **bn_kwargs())(x))
         x = global_avg_pool(x)
         return classifier_head(x, self.num_classes)
 

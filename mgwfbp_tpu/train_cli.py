@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 from typing import Optional
 
 from mgwfbp_tpu.config import PRESETS, TrainConfig, make_config
@@ -136,12 +137,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
 
     apply_platform_overrides()
-    if not (args.coordinator or args.num_processes):
+    multi_host = bool(
+        args.coordinator
+        or args.num_processes
+        or args.process_id is not None
+        or os.environ.get("MGWFBP_NUM_PROCESSES")
+    )
+    if not multi_host:
         # fail fast on a wedged device grant instead of hanging in PJRT
         # init (MGWFBP_INIT_TIMEOUT_S tunes/disables). Single-process
         # only: jax.distributed.initialize() must run before any backend
-        # touch, so multi-host launches skip the probe — there the
-        # coordinator barrier itself surfaces a dead host.
+        # touch, so every multi-host signal init_distributed honours
+        # (flags OR the MGWFBP_NUM_PROCESSES env) skips the probe — there
+        # the coordinator barrier itself surfaces a dead host.
         preflight_backend()
     from mgwfbp_tpu.parallel.mesh import init_distributed
     from mgwfbp_tpu.train.trainer import Trainer

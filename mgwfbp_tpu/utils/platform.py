@@ -67,6 +67,67 @@ def already_initialized_platforms() -> list[str]:
         return []
 
 
+# Peak dense-matmul FLOP/s per chip by device-kind substring (bf16 for TPU
+# generations; for fp32 runs it is an upper bound, making MFU conservative.
+# Tiny nominal value keeps MFU meaningful in CPU smoke runs). Shared by
+# bench.py and tools/mfu_ablation.py so the table cannot drift.
+PEAK_FLOPS_BY_DEVICE_KIND = [
+    ("v5 lite", 197e12),  # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),  # Trillium
+    ("cpu", 1e11),
+]
+
+
+def peak_flops(device_kind: str):
+    """Peak FLOP/s for a device kind, or None when unknown."""
+    kind = device_kind.lower()
+    for sub, peak in PEAK_FLOPS_BY_DEVICE_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+class DeadlineExceeded(RuntimeError):
+    """run_with_deadline hit its timeout (the worker thread is abandoned)."""
+
+
+def run_with_deadline(fn, timeout_s: float, what: str = "operation"):
+    """Run fn() on a daemon thread and wait at most timeout_s.
+
+    Returns fn()'s value; raises DeadlineExceeded on timeout, else
+    re-raises fn's own exception unchanged. One implementation of the
+    spawn/box/join/is_alive watchdog pattern — backend init and the bench
+    compute preflight both need it (a wedged remote device blocks
+    arbitrary client calls indefinitely; a deadline turns the hang into a
+    reportable error). The abandoned thread is a daemon: it cannot keep
+    the process alive, but any C-level lock it holds stays held — callers
+    should treat a DeadlineExceeded process as tainted and exit soon.
+    """
+    import threading
+
+    box: dict = {}
+
+    def work():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise DeadlineExceeded(
+            f"{what} exceeded {timeout_s:.0f}s deadline"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
 def preflight_backend(timeout_s: Optional[float] = None) -> list:
     """Initialize the JAX backend under a deadline; raise instead of hang.
 
@@ -80,32 +141,20 @@ def preflight_backend(timeout_s: Optional[float] = None) -> list:
     timeout_s: None reads MGWFBP_INIT_TIMEOUT_S (default 300); <= 0
     disables the deadline. Returns jax.devices() on success.
     """
-    import threading
-
     if timeout_s is None:
         timeout_s = float(os.environ.get("MGWFBP_INIT_TIMEOUT_S", "300"))
     import jax
 
     if timeout_s <= 0:
         return jax.devices()
-    box: dict = {}
-
-    def init():
-        try:
-            box["devices"] = jax.devices()
-        except BaseException as e:  # noqa: BLE001 — re-raised below
-            box["error"] = e
-
-    t = threading.Thread(target=init, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
+    try:
+        return run_with_deadline(
+            jax.devices, timeout_s, what="JAX backend init"
+        )
+    except DeadlineExceeded:
         raise RuntimeError(
             f"JAX backend init exceeded {timeout_s:.0f}s — device/tunnel "
             "unavailable (client blocked waiting for the device grant). "
             "Retry later, probe with `timeout 60 python -c 'import jax; "
             "jax.devices()'`, or raise MGWFBP_INIT_TIMEOUT_S."
-        )
-    if "error" in box:
-        raise box["error"]
-    return box["devices"]
+        ) from None

@@ -38,10 +38,9 @@ ARTIFACT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "profiles", "mfu_roofline_resnet50_tpu.json",
 )
-PEAK_BF16 = 197e12  # v5e
 
 
-def _build(batch, uint8_input=False, iters=30):
+def _build(batch, uint8_input=False):
     """Bench-protocol setup for one row: returns (timed_fn, state, batch,
     flops, bytes_accessed)."""
     import jax
@@ -59,20 +58,22 @@ def _build(batch, uint8_input=False, iters=30):
     input_dtype = meta.input_dtype
 
     if uint8_input:
-        inner = model
 
         class Uint8Normalize(nn.Module):
             """uint8 NHWC in; dequantize+normalize on device in bf16.
             Models the H2D-lean input path (the data loader ships raw
-            bytes; normalization constants baked into the graph)."""
+            bytes; normalization constants baked into the graph). The
+            wrapped model is a FIELD so flax binds it as a submodule."""
+
+            inner: nn.Module
 
             @nn.compact
             def __call__(self, x, train=True):
                 x = x.astype(jnp.bfloat16) * jnp.bfloat16(1.0 / 255.0)
                 x = (x - jnp.bfloat16(0.45)) * jnp.bfloat16(1.0 / 0.225)
-                return inner(x, train=train)
+                return self.inner(x, train=train)
 
-        model = Uint8Normalize()
+        model = Uint8Normalize(inner=model)
         input_dtype = jnp.uint8
 
     tx, _ = make_optimizer(
@@ -116,31 +117,46 @@ def _time_row(compiled, state, bd, iters):
         state, metrics = compiled(state, bd)
     loss = float(metrics["loss"])  # ONE end sync brackets the chain
     dt = (time.perf_counter() - t0) / iters
-    assert loss == loss, "non-finite loss"
+    import math
+
+    assert math.isfinite(loss), f"non-finite loss {loss}"
     return dt
 
 
 def run_rows(iters):
     import jax
 
+    # device-kind-keyed peak (shared package table) instead of a
+    # hardcoded v5e constant: mfu on any other device would be wrong
+    from mgwfbp_tpu.utils.platform import peak_flops
+
+    peak = peak_flops(jax.devices()[0].device_kind)
     rows = {}
 
     def measure(name, batch, uint8_input=False, bn_bf16=False):
+        prior = os.environ.get("MGWFBP_BN_DTYPE")
         if bn_bf16:
             os.environ["MGWFBP_BN_DTYPE"] = "bfloat16"
+        else:
+            # rows labeled baseline must BE the baseline even if the
+            # caller exported the knob globally
+            os.environ.pop("MGWFBP_BN_DTYPE", None)
         try:
             compiled, state, bd, flops, nbytes = _build(
-                batch, uint8_input=uint8_input, iters=iters
+                batch, uint8_input=uint8_input
             )
             dt = _time_row(compiled, state, bd, iters)
         finally:
-            os.environ.pop("MGWFBP_BN_DTYPE", None)
+            if prior is None:
+                os.environ.pop("MGWFBP_BN_DTYPE", None)
+            else:
+                os.environ["MGWFBP_BN_DTYPE"] = prior
         del compiled, state, bd
         rows[name] = {
             "batch": batch,
             "sec_per_iter": round(dt, 6),
             "images_per_sec": round(batch / dt, 1),
-            "mfu": round(flops / dt / PEAK_BF16, 4),
+            "mfu": round(flops / dt / peak, 4) if peak else None,
             "flops_per_step": flops,
             "xla_bytes_accessed_GB": round(nbytes / 1e9, 3),
             "achieved_GBps_on_xla_bytes": round(nbytes / dt / 1e9, 1),
