@@ -1,0 +1,69 @@
+"""run_with_deadline + bench compute preflight (failure detection,
+SURVEY §5): a device that accepts a session but executes nothing must
+become a fast, typed error — not an indefinite hang (the r5 outage mode;
+the r4 mode wedged at init and is covered by test_watchdog's preflight
+test)."""
+
+import time
+
+import pytest
+
+from mgwfbp_tpu.utils.platform import DeadlineExceeded, run_with_deadline
+
+
+def test_returns_value():
+    assert run_with_deadline(lambda: 42, 5.0) == 42
+
+
+def test_deadline_raises_typed_error():
+    with pytest.raises(DeadlineExceeded, match="slowop"):
+        run_with_deadline(lambda: time.sleep(30), 0.1, what="slowop")
+
+
+def test_worker_exception_propagates_unchanged():
+    with pytest.raises(ZeroDivisionError):
+        run_with_deadline(lambda: 1 / 0, 5.0)
+
+
+def test_bench_preflight_skip_and_wedge(monkeypatch):
+    import bench
+
+    # env 0 skips entirely (no device touch): must return instantly even
+    # with a wedged probe
+    monkeypatch.setenv("MGWFBP_BENCH_PREFLIGHT_S", "0")
+    bench._compute_preflight()
+
+    # wedged compute: both attempts time out, final error is RuntimeError
+    # with the actionable message (what the driver sees in the payload)
+    monkeypatch.setenv("MGWFBP_BENCH_PREFLIGHT_S", "0.1")
+    calls = []
+    monkeypatch.setattr(
+        "mgwfbp_tpu.utils.platform.run_with_deadline",
+        lambda fn, s, what="": calls.append(1) or (_ for _ in ()).throw(
+            DeadlineExceeded(f"{what} exceeded {s}s deadline")
+        ),
+    )
+    monkeypatch.setattr(time, "sleep", lambda s: None)  # skip backoff
+    with pytest.raises(RuntimeError, match="executes nothing"):
+        bench._compute_preflight(attempts=2)
+    assert len(calls) == 2
+
+
+def test_bench_preflight_recovers_on_retry(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("MGWFBP_BENCH_PREFLIGHT_S", "0.1")
+    attempts = []
+
+    def flaky(fn, s, what=""):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise DeadlineExceeded("transient")
+        return 1.0
+
+    monkeypatch.setattr(
+        "mgwfbp_tpu.utils.platform.run_with_deadline", flaky
+    )
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    bench._compute_preflight(attempts=2)  # no raise
+    assert len(attempts) == 2
