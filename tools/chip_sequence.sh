@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Chip-recovery work queue (r5): run the four chip legs in dependency
+# order as soon as the tunnel serves compute again. Each leg logs under
+# logs/chip_sequence/ and a failed leg does not block the later ones
+# (they exercise independent paths). Calibration runs FIRST because it is
+# the lightest leg (KB..MB payloads, minutes) and it produces
+# profiles/tpu_v5e_family.json, which the bench leg — and the driver's
+# end-of-round bench — load so their tails stop carrying the
+# UNCALIBRATED-prior warning (VERDICT r4 #5).
+#
+# Usage: bash tools/chip_sequence.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR=${1:-logs/chip_sequence}
+mkdir -p "$LOGDIR"
+
+echo "[seq $(date -u +%H:%M:%S)] leg 1/4: calibrate --prior-extend ici"
+timeout 2400 python -m mgwfbp_tpu.calibrate \
+  --out profiles/tpu_v5e_family.json --prior-extend ici \
+  >"$LOGDIR/calibrate.json" 2>"$LOGDIR/calibrate.err"
+echo "[seq $(date -u +%H:%M:%S)] calibrate rc=$? $(cat "$LOGDIR/calibrate.json")"
+
+echo "[seq $(date -u +%H:%M:%S)] leg 2/4: bench.py"
+timeout 2400 python bench.py >"$LOGDIR/bench.json" 2>"$LOGDIR/bench.err"
+echo "[seq $(date -u +%H:%M:%S)] bench rc=$? payload: $(cat "$LOGDIR/bench.json")"
+
+echo "[seq $(date -u +%H:%M:%S)] leg 3/4: mfu_ablation"
+timeout 3600 python tools/mfu_ablation.py \
+  >"$LOGDIR/mfu_ablation.log" 2>&1
+echo "[seq $(date -u +%H:%M:%S)] mfu rc=$?"
+
+echo "[seq $(date -u +%H:%M:%S)] leg 4/4: AN4 memorization run (train-as-val)"
+MGWFBP_WATCHDOG_S=900 timeout 7200 python -m mgwfbp_tpu.train_cli \
+  --dnn lstman4 --data-dir data/an4_memcheck --max-epochs 300 \
+  --logdir logs/an4_memcheck \
+  >"$LOGDIR/an4_memcheck.log" 2>&1
+echo "[seq $(date -u +%H:%M:%S)] an4 rc=$?"
+echo "[seq $(date -u +%H:%M:%S)] done"
