@@ -9,7 +9,11 @@ initializes its backends, hence the env mutation at conftest import time.
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+# tools/ scripts (policy_grid, an4_report) are imported by artifact-pinning
+# tests; one insert here replaces per-test sys.path mutation
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override any TPU tunnel platform
 flags = os.environ.get("XLA_FLAGS", "")

@@ -78,11 +78,6 @@ def test_scaling_harness_cpu8_artifact():
 
 def test_scaling_harness_runs_small(tmp_path):
     """Harness smoke: tiny model, 2 extents, writes a parseable artifact."""
-    import sys
-
-    sys.path.insert(
-        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
-    )
     import scaling_efficiency
 
     out = str(tmp_path / "s.json")
@@ -273,11 +268,6 @@ def test_policy_grid_sign_test_fields_consistent(name):
     exactly the all-rounds-slower REAL policies (the '#'-tagged noise
     control is the yardstick, never a competitor), and auto is not a
     consistent loser on any committed grid."""
-    import sys
-
-    sys.path.insert(
-        0, os.path.join(os.path.dirname(PROFILES), "tools")
-    )
     from policy_grid import _binom_tail_p
 
     d = json.load(open(os.path.join(PROFILES, name)))
