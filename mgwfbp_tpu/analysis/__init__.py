@@ -1,0 +1,39 @@
+"""Static analysis for the MG-WFBP hot path.
+
+Two passes, one CLI (`python -m mgwfbp_tpu.analysis`):
+
+  * `jaxpr_check` — trace the jitted train step on abstract inputs and
+    verify the lowered program realizes the merge schedule (group count,
+    bucket sizes/dtypes, no stray collectives or host callbacks, buffer
+    donation). Rule ids SCH001..SCH007.
+  * `ast_lint` — AST rules for tracing-unsafe Python inside jitted code
+    (wall clocks, numpy RNG, host round-trips, Python branches on traced
+    values, mutable defaults). Rule ids JIT000..JIT005.
+
+Findings print as ``file:line RULE message``; suppress a lint finding
+in-line with ``# graft: noqa[RULE]``. See README "Static analysis".
+"""
+
+from mgwfbp_tpu.analysis.rules import (  # noqa: F401
+    ERROR,
+    WARNING,
+    Finding,
+    Rule,
+    RULES,
+    filter_suppressed,
+    has_errors,
+    suppressed_ids,
+)
+from mgwfbp_tpu.analysis.ast_lint import (  # noqa: F401
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from mgwfbp_tpu.analysis.jaxpr_check import (  # noqa: F401
+    collect_collectives,
+    find_donated,
+    iter_eqns,
+    trace_train_step,
+    verify_jaxpr_against_reducer,
+    verify_train_step,
+)

@@ -1,0 +1,75 @@
+"""CLI: run both analysis passes and exit non-zero on errors.
+
+    python -m mgwfbp_tpu.analysis                 # lint package + verify step
+    python -m mgwfbp_tpu.analysis --skip-jaxpr    # AST lint only (fast)
+    python -m mgwfbp_tpu.analysis path/to/file.py # lint specific targets
+
+The jaxpr pass traces the jitted MG-WFBP train step on an 8-device virtual
+CPU mesh — pure tracing, no computation, no accelerator needed — once per
+merge policy, so the schedule-realization invariants are checked across the
+whole policy surface (wfbp / single / mgwfbp), not just the default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mgwfbp_tpu.analysis",
+        description="MG-WFBP static analysis: jit-safety lint + "
+        "jaxpr merge-schedule verification",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the mgwfbp_tpu package)",
+    )
+    parser.add_argument("--skip-lint", action="store_true",
+                        help="skip the AST lint pass")
+    parser.add_argument("--skip-jaxpr", action="store_true",
+                        help="skip the jaxpr schedule-verification pass")
+    parser.add_argument("--model", default="lenet",
+                        help="model to trace in the jaxpr pass")
+    parser.add_argument(
+        "--policies", default="wfbp,single,mgwfbp",
+        help="comma-separated merge policies to verify (jaxpr pass)",
+    )
+    parser.add_argument("--warnings-as-errors", action="store_true",
+                        help="exit non-zero on warnings too")
+    args = parser.parse_args(argv)
+
+    from mgwfbp_tpu.analysis.rules import ERROR, WARNING
+
+    findings = []
+    if not args.skip_lint:
+        from mgwfbp_tpu.analysis.ast_lint import lint_paths
+
+        targets = args.paths or [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))]
+        findings.extend(lint_paths(targets))
+
+    if not args.skip_jaxpr:
+        from mgwfbp_tpu.analysis.jaxpr_check import verify_train_step
+
+        for policy in [p.strip() for p in args.policies.split(",") if p.strip()]:
+            findings.extend(verify_train_step(args.model, policy))
+
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warnings = sum(1 for f in findings if f.severity == WARNING)
+    for f in findings:
+        print(f.format())
+    print(
+        f"mgwfbp_tpu.analysis: {errors} error(s), {warnings} warning(s)",
+        file=sys.stderr,
+    )
+    if errors or (warnings and args.warnings_as_errors):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

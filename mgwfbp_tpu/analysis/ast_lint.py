@@ -1,0 +1,317 @@
+"""AST lint for tracing-unsafe Python inside jitted/scanned code.
+
+A jitted function's Python body runs ONCE, at trace time; anything that
+reads the host environment (clocks, numpy RNG) is frozen into the compiled
+program, and anything that forces a traced value to a Python scalar either
+fails under jit or silently de-optimizes. These bugs tend to survive
+review because the first (tracing) call looks correct.
+
+Scope model — deliberately conservative to keep false positives near zero:
+a function is considered TRACED when
+  * it is decorated with jit/pmap (bare, dotted, or via
+    ``partial(jax.jit, ...)``), or
+  * its name is passed as the first argument to a tracing combinator
+    anywhere in the module (``jax.jit(f)``, ``shard_map(f, ...)``,
+    ``lax.scan(f, ...)``, ``jax.grad(f)``, ``jax.vmap(f)``, ...), or
+  * it is lexically nested inside a traced function.
+Helpers merely CALLED from traced code are not chased (no interprocedural
+taint); rule JIT003's float()/int()/bool() form only fires when the
+argument is rooted at one of the traced function's own parameters, so
+Python-level config scalars stay flaggable-free.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Sequence
+
+from mgwfbp_tpu.analysis.rules import Finding, filter_suppressed
+
+# call names (rightmost dotted segment) whose first function-valued argument
+# becomes traced code
+_TRACING_COMBINATORS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "shard_map",
+    "scan", "cond", "while_loop", "fori_loop", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "eval_shape", "make_jaxpr", "xmap",
+}
+
+_WALLCLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+_TRACED_MODULE_ROOTS = ("jnp.", "jax.", "lax.")
+
+# jax APIs that operate on pytree STRUCTURE, not traced values — a Python
+# branch on these is static and legal (e.g. `if tree_leaves(bstats):`)
+_STRUCTURAL_PREFIXES = (
+    "jax.tree_util.", "jax.tree.", "jax.dtypes.", "jnp.dtype",
+    "jnp.issubdtype", "jax.eval_shape", "jnp.shape", "jnp.ndim",
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """@jit / @jax.jit / @partial(jax.jit, ...) / @functools.partial(jit,..)."""
+    name = _dotted(dec)
+    if name is not None:
+        return name.rsplit(".", 1)[-1] in ("jit", "pmap")
+    if isinstance(dec, ast.Call):
+        fn = _dotted(dec.func)
+        if fn is not None:
+            tail = fn.rsplit(".", 1)[-1]
+            if tail in ("jit", "pmap"):
+                return True
+            if tail == "partial" and dec.args:
+                inner = _dotted(dec.args[0])
+                if inner is not None and inner.rsplit(".", 1)[-1] in (
+                    "jit", "pmap"
+                ):
+                    return True
+    return False
+
+
+class _TracedNameCollector(ast.NodeVisitor):
+    """Names passed by reference into tracing combinators, module-wide."""
+
+    def __init__(self) -> None:
+        self.names: set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = _dotted(node.func)
+        if fn is not None and fn.rsplit(".", 1)[-1] in _TRACING_COMBINATORS:
+            for arg in node.args[:1]:  # the function operand is leading
+                name = _dotted(arg)
+                if name is not None and "." not in name:
+                    self.names.add(name)
+        self.generic_visit(node)
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    a = fn.args
+    params = [*a.posonlyargs, *a.args, *a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg)
+    if a.kwarg:
+        params.append(a.kwarg)
+    return {p.arg for p in params}
+
+
+def _static_param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Params declared static via static_argnums/static_argnames on a jit
+    decorator — these are concrete Python values, so host conversions of
+    them (int()/float()/bool()) are legal and must not trip JIT003."""
+    positional = [*fn.args.posonlyargs, *fn.args.args]
+    static: set[str] = set()
+
+    def const_values(node: ast.AST) -> list:
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+        return []
+
+    for dec in fn.decorator_list:
+        if not (isinstance(dec, ast.Call) and _is_jit_decorator(dec)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnums":
+                for v in const_values(kw.value):
+                    if isinstance(v, int) and 0 <= v < len(positional):
+                        static.add(positional[v].arg)
+            elif kw.arg == "static_argnames":
+                for v in const_values(kw.value):
+                    if isinstance(v, str):
+                        static.add(v)
+    return static
+
+
+def _rooted_at(node: ast.AST, names: set[str]) -> bool:
+    """Expression is a Name/Attribute/Subscript chain rooted at `names`."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in names
+
+
+def _contains_traced_call(node: ast.AST) -> bool:
+    """Subtree contains a call into jnp./jax./lax. — a traced producer."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = _dotted(sub.func)
+            if (
+                fn is not None
+                and fn.startswith(_TRACED_MODULE_ROOTS)
+                and not fn.startswith(_STRUCTURAL_PREFIXES)
+            ):
+                return True
+    return False
+
+
+class _TracedBodyChecker(ast.NodeVisitor):
+    """Rule checks inside one traced function body (without nested defs —
+    those are visited as traced functions in their own right)."""
+
+    def __init__(self, path: str, fn: ast.FunctionDef, findings: list):
+        self.path = path
+        self.fn = fn
+        self.params = _param_names(fn) - _static_param_names(fn)
+        self.findings = findings
+
+    def _add(self, node: ast.AST, rule_id: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.path, getattr(node, "lineno", 0), rule_id, msg)
+        )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.fn:
+            return  # nested def: checked separately with its own params
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = _dotted(node.func)
+        if fn is not None:
+            tail2 = ".".join(fn.split(".")[-2:])
+            if fn in _WALLCLOCK_CALLS or tail2 in _WALLCLOCK_CALLS:
+                self._add(node, "JIT001",
+                          f"'{fn}()' inside traced '{self.fn.name}'")
+            elif fn.startswith(("np.random.", "numpy.random.")):
+                self._add(node, "JIT002",
+                          f"'{fn}()' inside traced '{self.fn.name}'")
+            elif fn in ("float", "int", "bool") and node.args:
+                if _rooted_at(node.args[0], self.params) or (
+                    _contains_traced_call(node.args[0])
+                ):
+                    self._add(
+                        node, "JIT003",
+                        f"'{fn}()' forces a traced value to host in "
+                        f"'{self.fn.name}'",
+                    )
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._add(node, "JIT003",
+                      f"'.item()' forces a traced value to host in "
+                      f"'{self.fn.name}'")
+        self.generic_visit(node)
+
+    def _check_branch(self, node: ast.If | ast.While, kind: str) -> None:
+        if _contains_traced_call(node.test):
+            self._add(
+                node, "JIT004",
+                f"Python '{kind}' on a traced expression in "
+                f"'{self.fn.name}' — the branch is frozen at trace time",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+
+def _mutable_default_findings(
+    path: str, fn: ast.FunctionDef, findings: list
+) -> None:
+    for default in [*fn.args.defaults, *fn.args.kw_defaults]:
+        if default is None:
+            continue
+        mutable = isinstance(default, (ast.List, ast.Dict, ast.Set))
+        if isinstance(default, ast.Call):
+            callee = _dotted(default.func)
+            mutable = callee in ("list", "dict", "set")
+        if mutable:
+            findings.append(Finding(
+                path, default.lineno, "JIT005",
+                f"mutable default argument on jitted '{fn.name}'",
+            ))
+
+
+def lint_source(source: str, path: str = "<string>") -> list:
+    """Lint one module's source; returns noqa-filtered findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "JIT000",
+                        f"unparseable module: {e.msg}")]
+    collector = _TracedNameCollector()
+    collector.visit(tree)
+    traced_names = collector.names
+
+    findings: list = []
+
+    def visit_functions(node: ast.AST, inside_traced: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                decorated = any(
+                    _is_jit_decorator(d) for d in child.decorator_list
+                )
+                traced = (
+                    inside_traced
+                    or decorated
+                    or child.name in traced_names
+                )
+                if traced:
+                    _TracedBodyChecker(path, child, findings).visit(child)
+                    if decorated:
+                        _mutable_default_findings(path, child, findings)
+                visit_functions(child, traced)
+            else:
+                visit_functions(child, inside_traced)
+
+    visit_functions(tree, False)
+    return filter_suppressed(findings, source.splitlines())
+
+
+def lint_file(path: str) -> list:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return [Finding(path, 0, "JIT000", f"cannot read lint target: {e}")]
+    except UnicodeDecodeError as e:
+        return [Finding(path, 0, "JIT000", f"cannot decode lint target: {e}")]
+    return lint_source(source, path)
+
+
+def lint_paths(paths: Sequence[str]) -> list:
+    """Lint .py files (recursing into directories), sorted findings.
+
+    A target that is neither a directory nor an existing .py file yields a
+    JIT000 error finding rather than being dropped — a mistyped path must
+    not turn the CI gate green by linting nothing.
+    """
+    import os
+
+    files: list[str] = []
+    findings: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(
+                    os.path.join(root, n) for n in names if n.endswith(".py")
+                )
+        elif p.endswith(".py") and os.path.isfile(p):
+            files.append(p)
+        else:
+            findings.append(Finding(
+                p, 0, "JIT000",
+                "lint target is not a directory or existing .py file",
+            ))
+    for f in sorted(files):
+        findings.extend(lint_file(f))
+    return findings

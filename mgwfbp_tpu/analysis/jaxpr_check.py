@@ -1,0 +1,348 @@
+"""Jaxpr-level verifier: does the lowered train step realize the schedule?
+
+MG-WFBP's value proposition is that the merge schedule the solver emits is
+ACTUALLY issued as N dtype-homogeneous fused collectives overlapping the
+backward pass. Nothing at runtime checks that — a refactor of the step, a
+jax upgrade, or an overeager XLA pass can silently degrade collective
+granularity (the failure mode DeAR, arXiv:2302.12445, documents) while
+training still converges. This pass traces the jitted step on ABSTRACT
+inputs (`jax.make_jaxpr`; no devices execute anything) and statically
+asserts, against the `MergedAllreduce` that built it:
+
+  SCH003  the bucket layout covers every gradient leaf exactly once, with
+          dtype-homogeneous groups and consistent offsets
+          (`BucketLayout.validate`);
+  SCH001  the traced program contains exactly `layout.num_groups` merged
+          reduction collectives (matched via the `mgwfbp_groupNNNN` name
+          scopes `parallel.allreduce` stamps on them);
+  SCH007  each group's collective carries exactly the group's element count;
+  SCH002  ... at the layout's bucket dtype (or the comm_dtype wire cast);
+  SCH004  no OTHER collective appears outside the declared scopes
+          (metrics_reduce / bstats_reduce / flat_grad_reduce) — a stray
+          all_gather/all_to_all or an unscoped psum is granularity silently
+          leaking away;
+  SCH005  no host callbacks / debug prints ride the hot path;
+  SCH006  the step donates its input buffers (params/opt-state aliasing —
+          without it every step round-trips a full model copy through HBM).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+from mgwfbp_tpu.analysis.rules import Finding
+
+# --- primitive taxonomy (names as of jax 0.4.x; matching is by name so the
+# verifier needs no private jax imports) ------------------------------------
+REDUCTION_PRIMS = frozenset({"psum", "reduce_scatter", "psum_scatter"})
+OTHER_COLLECTIVE_PRIMS = frozenset({
+    "all_gather", "all_to_all", "pmax", "pmin", "ppermute", "pgather",
+})
+COLLECTIVE_PRIMS = REDUCTION_PRIMS | OTHER_COLLECTIVE_PRIMS
+CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "outside_call",
+    "host_callback_call", "python_callback",
+})
+
+# scopes the train step declares for its OWN auxiliary collectives
+# (train/step.py); anything else collective-shaped must be a merge group
+DEFAULT_ALLOWED_SCOPES = (
+    "metrics_reduce", "bstats_reduce", "flat_grad_reduce",
+)
+
+
+def _group_scope_re() -> "re.Pattern[str]":
+    """Regex for the merge-group scope, derived from the prefix constant
+    `parallel.allreduce` stamps (import deferred: the lint-only CLI path
+    must not pull jax in through this module)."""
+    from mgwfbp_tpu.parallel.allreduce import GROUP_SCOPE_PREFIX
+
+    return re.compile(re.escape(GROUP_SCOPE_PREFIX) + r"(\d+)")
+
+
+def _scope_segments(scope: str) -> list[str]:
+    """Name-stack entries of a rendered scope string, transformation
+    wrappers stripped: 'transpose(jvp(metrics_reduce))/foo' ->
+    ['metrics_reduce', 'foo']. Segment-exact matching keeps a scope like
+    'extra_metrics_reduce_v2' from whitelisting stray collectives."""
+    out = []
+    for seg in scope.split("/"):
+        while True:
+            m = re.fullmatch(r"\w+\((.*)\)", seg)
+            if m is None:
+                break
+            seg = m.group(1)
+        out.append(seg)
+    return out
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Depth-first walk of a jaxpr's eqns, recursing into every sub-jaxpr
+    found in eqn params (pjit/shard_map/scan/cond/custom_* all carry their
+    bodies under different param keys; duck-type instead of enumerating)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _sub_jaxprs(v: Any) -> Iterator[Any]:
+    if hasattr(v, "eqns"):  # core.Jaxpr
+        yield v
+    elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # ClosedJaxpr
+        yield v.jaxpr
+    elif isinstance(v, (tuple, list)):
+        for u in v:
+            yield from _sub_jaxprs(u)
+
+
+def _scope_of(eqn: Any) -> str:
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+
+
+def _numel(aval: Any) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n
+
+
+def collect_collectives(closed_jaxpr: Any) -> dict[str, list]:
+    """Classify every collective/callback eqn in the traced program.
+
+    Returns {"groups": {gi: [eqn, ...]}, "allowed": [...], "stray": [...],
+    "callbacks": [...]} where group membership comes from the
+    `mgwfbp_groupNNNN` name scope stamped by `parallel.allreduce`.
+    """
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    group_re = _group_scope_re()
+    groups: dict[int, list] = {}
+    allowed: list = []
+    stray: list = []
+    callbacks: list = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMS:
+            callbacks.append(eqn)
+            continue
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        scope = _scope_of(eqn)
+        m = group_re.search(scope)
+        if m is not None:
+            groups.setdefault(int(m.group(1)), []).append(eqn)
+        elif any(
+            seg in DEFAULT_ALLOWED_SCOPES for seg in _scope_segments(scope)
+        ):
+            allowed.append(eqn)
+        else:
+            stray.append(eqn)
+    return {
+        "groups": groups, "allowed": allowed, "stray": stray,
+        "callbacks": callbacks,
+    }
+
+
+def find_donated(closed_jaxpr: Any) -> Optional[tuple[bool, ...]]:
+    """donated_invars of the outermost pjit eqn, or None when untraceable."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pjit":
+            d = eqn.params.get("donated_invars")
+            if d is not None:
+                return tuple(bool(x) for x in d)
+    return None
+
+
+def verify_jaxpr_against_reducer(
+    closed_jaxpr: Any,
+    reducer: Any,
+    grad_leaves: Sequence[Any],
+    *,
+    expect_donation: bool = True,
+    file: str = "<traced step>",
+) -> list[Finding]:
+    """Check the MG-WFBP invariants of a traced step against its reducer.
+
+    closed_jaxpr: `jax.make_jaxpr(step)(...)` output for the jitted step.
+    reducer: the `MergedAllreduce` the step was built with.
+    grad_leaves: gradient-leaf avals in ARRIVAL order (i.e. the layout's
+        leaf order — `[leaves[j] for j in reducer.perm]`).
+    """
+    layout = reducer.layout
+    schedule = reducer.schedule
+    out: list[Finding] = []
+
+    def add(rule_id: str, msg: str) -> None:
+        out.append(Finding(file, 0, rule_id, msg))
+
+    # --- structural pass: layout vs leaves (SCH003) ------------------------
+    for problem in layout.validate(grad_leaves):
+        add("SCH003", problem)
+    if layout.num_groups != schedule.num_groups:
+        add("SCH003",
+            f"layout has {layout.num_groups} groups but the schedule "
+            f"promises {schedule.num_groups}")
+
+    # --- lowered program vs layout -----------------------------------------
+    info = collect_collectives(closed_jaxpr)
+    groups = info["groups"]
+    if len(groups) != layout.num_groups:
+        add("SCH001",
+            f"traced step issues {len(groups)} merged collectives, "
+            f"schedule promises {layout.num_groups}")
+    comm_dtype = getattr(reducer, "comm_dtype", None)
+    # the hier/rs_ag lowerings pad buckets to scatter-axis divisibility, so
+    # their payload check is >=; the monolithic all-reduce is exact; a
+    # sparsifying compressor moves k <= n elements chosen at trace time, so
+    # no static payload expectation exists and the size check is skipped
+    padded = getattr(reducer, "comm_op", "all_reduce") != "all_reduce"
+    sparsified = getattr(reducer, "compressor", None) is not None
+    for gi in sorted(groups):
+        if gi >= layout.num_groups:
+            add("SCH001",
+                f"collective scoped to group {gi} but the layout only has "
+                f"{layout.num_groups} groups")
+            continue
+        eqn = groups[gi][0]  # primary reduction (rs_ag/hier add gathers)
+        aval = eqn.invars[0].aval
+        want_elems = layout.group_sizes[gi]
+        got_elems = _numel(aval)
+        ok = sparsified or (
+            got_elems >= want_elems if padded else got_elems == want_elems
+        )
+        if not ok:
+            add("SCH007",
+                f"group {gi} collective moves {got_elems} elements, layout "
+                f"says {want_elems}")
+        want_dtype = comm_dtype if comm_dtype is not None else (
+            layout.dtypes[gi]
+        )
+        if np.dtype(aval.dtype) != np.dtype(want_dtype):
+            add("SCH002",
+                f"group {gi} collective runs at dtype "
+                f"{np.dtype(aval.dtype).name}, layout bucket is "
+                f"{np.dtype(want_dtype).name}")
+
+    for eqn in info["stray"]:
+        add("SCH004",
+            f"unexpected '{eqn.primitive.name}' outside declared scopes "
+            f"(scope: {_scope_of(eqn) or '<none>'})")
+    for eqn in info["callbacks"]:
+        add("SCH005",
+            f"host callback '{eqn.primitive.name}' in the hot path "
+            f"(scope: {_scope_of(eqn) or '<none>'})")
+
+    if expect_donation:
+        donated = find_donated(closed_jaxpr)
+        if donated is None or not any(donated):
+            add("SCH006",
+                "no donated input buffers on the jitted step "
+                "(params/opt-state copy every iteration)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Self-contained verification target: build a representative train step and
+# check it. Used by the CLI and by the analyzer's own clean-on-HEAD test.
+# ---------------------------------------------------------------------------
+
+def _ensure_cpu_devices(n: int = 8) -> None:
+    """Force an n-device virtual CPU platform if jax has not initialized yet
+    (tracing needs a mesh, not real hardware)."""
+    from mgwfbp_tpu.utils.platform import (
+        already_initialized_platforms,
+        apply_platform_overrides,
+        force_host_device_count,
+    )
+
+    if already_initialized_platforms():
+        return  # too late to change; use whatever devices exist
+    force_host_device_count(n)
+    apply_platform_overrides("cpu")
+
+
+def trace_train_step(
+    model_name: str = "lenet",
+    policy: str = "mgwfbp",
+    *,
+    comm_dtype: Any = None,
+    donate: bool = True,
+    batch_size: int = 16,
+) -> tuple[Any, Any, list]:
+    """Build and trace a representative jitted MG-WFBP train step.
+
+    Returns (closed_jaxpr, reducer, grad_leaves_in_arrival_order) — the
+    exact inputs `verify_jaxpr_against_reducer` wants. Tracing only: state
+    is built with `jax.eval_shape`, the batch is ShapeDtypeStructs, nothing
+    executes on any device. Exposed separately from `verify_train_step` so
+    the analyzer's mutation tests can verify a REAL traced program against
+    a deliberately doctored expectation.
+    """
+    _ensure_cpu_devices()
+    import jax
+    import jax.numpy as jnp
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.optim import sgd
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+    from mgwfbp_tpu.parallel.costmodel import AlphaBeta
+    from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
+    from mgwfbp_tpu.train.step import create_train_state, make_train_step
+
+    mesh = make_mesh(MeshSpec(data=len(jax.devices()), seq=1))
+    model, meta = zoo.create_model(model_name)
+    tx = sgd(0.1, momentum=0.9)
+    # abstract state: full init math traced, nothing executed
+    state = jax.eval_shape(
+        lambda: create_train_state(
+            jax.random.PRNGKey(0), model, jnp.zeros((1,) + meta.input_shape),
+            tx,
+        )
+    )
+    kw: dict[str, Any] = {}
+    if policy == "mgwfbp":
+        kw = dict(cost_model=AlphaBeta(1e-4, 1e-9))
+    reducer = make_merged_allreduce(
+        state.params, axis_name=DATA_AXIS, policy=policy,
+        comm_dtype=comm_dtype, **kw,
+    )
+    step = make_train_step(model, meta, tx, mesh, reducer, donate=donate)
+    batch = {
+        "x": jax.ShapeDtypeStruct(
+            (1, batch_size) + meta.input_shape, jnp.float32
+        ),
+        "y": jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+    }
+    closed = jax.make_jaxpr(step)(state, batch)
+    leaves = jax.tree_util.tree_leaves(state.params)
+    arr = [leaves[j] for j in reducer.perm]
+    return closed, reducer, arr
+
+
+def verify_train_step(
+    model_name: str = "lenet",
+    policy: str = "mgwfbp",
+    *,
+    comm_dtype: Any = None,
+    donate: bool = True,
+    expect_donation: Optional[bool] = None,
+    batch_size: int = 16,
+) -> list[Finding]:
+    """Trace one representative jitted train step and verify it."""
+    closed, reducer, arr = trace_train_step(
+        model_name, policy, comm_dtype=comm_dtype, donate=donate,
+        batch_size=batch_size,
+    )
+    return verify_jaxpr_against_reducer(
+        closed, reducer, arr,
+        expect_donation=donate if expect_donation is None else expect_donation,
+        file=f"<train step {model_name}/{policy}>",
+    )

@@ -1,0 +1,126 @@
+"""Rule registry, findings, and suppression for the static-analysis suite.
+
+Two rule families share this framework:
+  * JIT0xx — AST lint rules for tracing-unsafe Python inside jitted/scanned
+    code (`analysis.ast_lint`);
+  * SCH0xx — jaxpr-level merge-schedule invariants checked against the
+    lowered train step (`analysis.jaxpr_check`).
+
+Findings print as ``file:line RULE message``. A finding on a source line
+carrying ``# graft: noqa`` (all rules) or ``# graft: noqa[JIT001]`` /
+``# graft: noqa[JIT001,SCH004]`` (listed rules only) is suppressed —
+jaxpr-level findings have no meaningful source line and cannot be noqa'd.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterable, Optional, Sequence
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str  # ERROR | WARNING
+    summary: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int  # 1-based; 0 = whole-program finding (jaxpr pass)
+    rule_id: str
+    message: str
+
+    @property
+    def rule(self) -> Rule:
+        return RULES[self.rule_id]
+
+    @property
+    def severity(self) -> str:
+        return self.rule.severity
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line} {self.rule_id} {self.message}"
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _register(id: str, severity: str, summary: str) -> Rule:
+    if id in RULES:
+        raise ValueError(f"duplicate rule id {id!r}")
+    r = Rule(id, severity, summary)
+    RULES[id] = r
+    return r
+
+
+# --- AST lint rules (tracing-unsafe Python in jitted code) -----------------
+_register("JIT000", ERROR,
+          "lint target missing, unreadable, or unparseable")
+_register("JIT001", ERROR,
+          "wall-clock call inside traced code (runs once at trace time)")
+_register("JIT002", ERROR,
+          "numpy RNG inside traced code (frozen at trace time; use jax.random)")
+_register("JIT003", ERROR,
+          "host round-trip on a traced value (.item()/float()/int()/bool())")
+_register("JIT004", WARNING,
+          "Python-level branch on a traced value (use lax.cond/jnp.where)")
+_register("JIT005", ERROR,
+          "mutable default argument on a jitted function (shared across traces)")
+
+# --- jaxpr schedule-verifier rules -----------------------------------------
+_register("SCH001", ERROR,
+          "merged-collective count differs from MergeSchedule.num_groups")
+_register("SCH002", ERROR,
+          "bucket collective dtype differs from the layout's bucket dtype")
+_register("SCH003", ERROR,
+          "bucket layout does not cover every gradient leaf exactly once")
+_register("SCH004", ERROR,
+          "unexpected collective in the hot path")
+_register("SCH005", ERROR,
+          "host callback / debug print in the hot path")
+_register("SCH006", ERROR,
+          "state buffers not donated to the train step")
+_register("SCH007", ERROR,
+          "bucket collective payload size differs from the layout's group size")
+
+
+_NOQA = re.compile(r"#\s*graft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s]+)\])?")
+
+
+def suppressed_ids(source_line: str) -> Optional[frozenset[str]]:
+    """Rule ids a ``# graft: noqa`` comment on this line suppresses.
+
+    Returns None when the line has no noqa marker; an EMPTY frozenset means
+    a bare marker (suppress every rule); otherwise the listed ids.
+    """
+    m = _NOQA.search(source_line)
+    if m is None:
+        return None
+    ids = m.group("ids")
+    if ids is None:
+        return frozenset()
+    return frozenset(s.strip() for s in ids.split(",") if s.strip())
+
+
+def filter_suppressed(
+    findings: Iterable[Finding], source_lines: Sequence[str]
+) -> list[Finding]:
+    """Drop findings whose source line carries a matching noqa marker."""
+    out = []
+    for f in findings:
+        if 1 <= f.line <= len(source_lines):
+            ids = suppressed_ids(source_lines[f.line - 1])
+            if ids is not None and (not ids or f.rule_id in ids):
+                continue
+        out.append(f)
+    return out
+
+
+def has_errors(findings: Iterable[Finding]) -> bool:
+    return any(f.severity == ERROR for f in findings)

@@ -33,6 +33,19 @@ from mgwfbp_tpu.parallel.solver import (
     predict_group_times,
     simulate_groups,
 )
+from mgwfbp_tpu.utils.platform import axis_size
+
+# Name-scope prefix stamped on every merge-group collective (the group index
+# is appended, zero-padded). XLA/jaxpr preserve the scope in op metadata, so
+# `mgwfbp_tpu.analysis.jaxpr_check` can statically match the collectives the
+# lowered program ACTUALLY issues against the MergeSchedule that promised
+# them. Keep in sync with analysis/jaxpr_check.py.
+GROUP_SCOPE_PREFIX = "mgwfbp_group"
+
+
+def group_scope_name(gi: int) -> str:
+    """Name-scope label for merge group `gi` (introspection hook)."""
+    return f"{GROUP_SCOPE_PREFIX}{gi:04d}"
 
 
 _DIGITS = re.compile(r"(\d+)")
@@ -87,9 +100,7 @@ def _scatter_mid_gather(
     semantics), all-gather back, trim the pad."""
     n = buf.shape[0]
     # static extents: mesh axis sizes are known at trace time
-    parts = 1
-    for a in scatter_axes:
-        parts *= int(lax.axis_size(a))
+    parts = axis_size(scatter_axes)
     pad = (-n) % parts
     if pad:
         buf = jnp.pad(buf, (0, pad))
@@ -110,9 +121,7 @@ def _rs_ag_allreduce(buf: jax.Array, axes, mean: bool) -> jax.Array:
     all-reduce's bytes, and XLA may overlap the all-gather of group k with
     other work more aggressively than a monolithic all-reduce. Numerically
     identical to pmean/psum."""
-    world = 1
-    for a in axes:
-        world *= int(lax.axis_size(a))
+    world = axis_size(axes)
     return _scatter_mid_gather(buf, axes, world if mean else 1)
 
 
@@ -136,7 +145,7 @@ def _hierarchical_allreduce(
     1/inner_size of it — the standard pod-slice hierarchy a flat psum over
     both axes leaves to XLA's discretion, made explicit so the solver's
     two-level cost predictions describe the actual wire traffic."""
-    world = int(lax.axis_size(inner_axis)) * int(lax.axis_size(outer_axis))
+    world = axis_size((inner_axis, outer_axis))
     return _scatter_mid_gather(
         buf,
         (inner_axis,),
@@ -204,29 +213,38 @@ def merged_psum(
     axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     token = None
     for gi in range(layout.num_groups):
-        buf = buckets_lib.pack_group(arr, layout, gi)
-        orig_dtype = buf.dtype
-        if comm_dtype is not None and buf.dtype != comm_dtype:
-            buf = buf.astype(comm_dtype)
-        if sequential and token is not None and jnp.issubdtype(
-            buf.dtype, jnp.inexact
-        ):
-            clean = jnp.where(
-                jnp.isfinite(token), token, jnp.zeros_like(token)
-            )
-            buf = buf + jnp.zeros((), buf.dtype) * clean.astype(buf.dtype)
-        if compressor is not None and jnp.issubdtype(buf.dtype, jnp.floating):
-            buf = compressor.allreduce(buf, axes, mean)
-        elif comm_op == "rs_ag":
-            buf = _rs_ag_allreduce(buf, axes, mean)
-        elif comm_op == "hier":
-            buf = _hierarchical_allreduce(buf, axes[0], axes[1], mean)
-        else:
-            buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
-        token = buf[0]
-        if buf.dtype != orig_dtype:
-            buf = buf.astype(orig_dtype)
-        for i, a in buckets_lib.unpack_group(buf, layout, gi, shapes).items():
+        # The named scope is the verifier's introspection hook: every
+        # primitive issued for this group (pack, the collective, unpack)
+        # carries group_scope_name(gi) in its jaxpr/XLA op metadata, so
+        # analysis.jaxpr_check can match lowered collectives to schedule
+        # groups without runtime instrumentation.
+        with jax.named_scope(group_scope_name(gi)):
+            buf = buckets_lib.pack_group(arr, layout, gi)
+            orig_dtype = buf.dtype
+            if comm_dtype is not None and buf.dtype != comm_dtype:
+                buf = buf.astype(comm_dtype)
+            if sequential and token is not None and jnp.issubdtype(
+                buf.dtype, jnp.inexact
+            ):
+                clean = jnp.where(
+                    jnp.isfinite(token), token, jnp.zeros_like(token)
+                )
+                buf = buf + jnp.zeros((), buf.dtype) * clean.astype(buf.dtype)
+            if compressor is not None and jnp.issubdtype(
+                buf.dtype, jnp.floating
+            ):
+                buf = compressor.allreduce(buf, axes, mean)
+            elif comm_op == "rs_ag":
+                buf = _rs_ag_allreduce(buf, axes, mean)
+            elif comm_op == "hier":
+                buf = _hierarchical_allreduce(buf, axes[0], axes[1], mean)
+            else:
+                buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
+            token = buf[0]
+            if buf.dtype != orig_dtype:
+                buf = buf.astype(orig_dtype)
+            unpacked = buckets_lib.unpack_group(buf, layout, gi, shapes)
+        for i, a in unpacked.items():
             out[i] = a
     restored: list[Any] = [None] * len(leaves)
     for k, j in enumerate(perm):

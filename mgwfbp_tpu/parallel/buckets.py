@@ -43,6 +43,62 @@ class BucketLayout:
     def num_groups(self) -> int:
         return len(self.groups)
 
+    def validate(
+        self, leaves: Sequence[jax.ShapeDtypeStruct | jax.Array]
+    ) -> list[str]:
+        """Structural MG-WFBP invariants of this layout against `leaves`
+        (arrival order). Returns human-readable violation strings, empty
+        when sound — the static pre-pass `mgwfbp_tpu.analysis.jaxpr_check`
+        runs before ever tracing a program:
+
+          * every leaf is a member of exactly one group (no drops, no dups);
+          * each group is dtype-homogeneous (build_layout's split rule —
+            a mixed bucket would silently upcast on concatenate);
+          * recorded offsets/sizes match the members' true element counts.
+        """
+        problems: list[str] = []
+        seen: dict[int, int] = {}
+        for gi, members in enumerate(self.groups):
+            if len(self.offsets[gi]) != len(members):
+                problems.append(
+                    f"group {gi} has {len(members)} members but "
+                    f"{len(self.offsets[gi])} offsets"
+                )
+                continue
+            acc = 0
+            for slot, idx in enumerate(members):
+                if idx in seen:
+                    problems.append(
+                        f"leaf {idx} in groups {seen[idx]} and {gi}"
+                    )
+                seen[idx] = gi
+                if not 0 <= idx < len(leaves):
+                    problems.append(f"group {gi} references leaf {idx} "
+                                    f"outside [0, {len(leaves)})")
+                    continue
+                if leaves[idx].dtype != self.dtypes[gi]:
+                    problems.append(
+                        f"group {gi} dtype {jnp.dtype(self.dtypes[gi]).name} "
+                        f"!= member leaf {idx} dtype "
+                        f"{jnp.dtype(leaves[idx].dtype).name}"
+                    )
+                if self.offsets[gi][slot] != acc:
+                    problems.append(
+                        f"group {gi} member {idx}: offset "
+                        f"{self.offsets[gi][slot]} != expected {acc}"
+                    )
+                shape = leaves[idx].shape
+                acc += int(np.prod(shape)) if shape else 1
+            if acc != self.group_sizes[gi]:
+                problems.append(
+                    f"group {gi} size {self.group_sizes[gi]} != member "
+                    f"element total {acc}"
+                )
+        missing = sorted(set(range(len(leaves))) - set(seen))
+        if missing:
+            problems.append(f"leaves {missing} belong to no group")
+        return problems
+
 
 def build_layout(
     leaves: Sequence[jax.ShapeDtypeStruct | jax.Array],

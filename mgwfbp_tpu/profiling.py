@@ -40,6 +40,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mgwfbp_tpu.parallel.costmodel import AlphaBeta, fit_alpha_beta
 from mgwfbp_tpu.parallel.mesh import DATA_AXIS
+from mgwfbp_tpu.utils.platform import get_shard_map
+
+shard_map = get_shard_map()
 
 # Reference sweep: 8K..504K float32 elements in 8K steps (profiling.py:158-160)
 # extended upward: TPU interconnects only hit peak bandwidth at MBs.
@@ -75,7 +78,7 @@ def profile_allreduce(
             return lax.pmean(x, axis_name)
 
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
             )
         )
@@ -134,7 +137,7 @@ def profile_group_overhead(
             return reducer(tree)
 
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
             )
         )
@@ -185,7 +188,7 @@ def profile_pack_overhead(
             names=[f"g{i:04d}" for i in range(len(leaves))],
         )
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda t: reducer(t), mesh=mesh, in_specs=P(), out_specs=P(),
                 check_vma=False,
             )
@@ -229,8 +232,6 @@ def profile_overlap_capability(
     reference assumes 1.0 unconditionally (NCCL streams), which mispredicts
     any platform that cannot overlap.
     """
-    from jax.sharding import PartitionSpec
-
     w = jnp.ones((512, 512), jnp.float32) * 1e-3
     payload = jnp.ones((payload_elems,), jnp.float32)
 
@@ -247,7 +248,7 @@ def profile_overlap_capability(
 
     def time_fn(body, out_spec):
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body, mesh=mesh, in_specs=(P(), P()), out_specs=out_spec,
                 check_vma=False,
             )

@@ -26,7 +26,6 @@ Sharding: params/opt_state replicated (P()), batch sharded on the data axis
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -40,6 +39,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from mgwfbp_tpu.models import ModelMeta
 from mgwfbp_tpu.parallel.allreduce import MergedAllreduce
 from mgwfbp_tpu.parallel.mesh import DATA_AXIS
+from mgwfbp_tpu.utils.platform import get_shard_map
+
+shard_map = get_shard_map()
 
 
 class TrainState(struct.PyTreeNode):
@@ -332,16 +334,23 @@ def make_train_step(
         grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics)
         # ---- the communication step: merged groups or one flat pmean ----
+        # Named scopes classify every collective for analysis.jaxpr_check:
+        # grad reductions live under the reducer's per-group scopes (or
+        # "flat_grad_reduce"); the metrics/BN-stats pmeans are declared
+        # auxiliary so the verifier can tell them from hot-path strays.
         if reducer is not None:
             grads = reducer(grads)
         else:
-            grads = lax.pmean(grads, red_axes)
-        metrics = lax.pmean(metrics, red_axes)
+            with jax.named_scope("flat_grad_reduce"):
+                grads = lax.pmean(grads, red_axes)
+        with jax.named_scope("metrics_reduce"):
+            metrics = lax.pmean(metrics, red_axes)
         # BN running stats: keep replicas identical (the reference leaves
         # them per-GPU; syncing is strictly better and required for the
         # replicated out-spec)
         if jax.tree_util.tree_leaves(bstats):
-            bstats = lax.pmean(bstats, red_axes)
+            with jax.named_scope("bstats_reduce"):
+                bstats = lax.pmean(bstats, red_axes)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -359,7 +368,7 @@ def make_train_step(
         # (nsteps, batch, time): batch over data, time over seq
         batch_spec = P(None, data_axes, seq_axis)
     if has_carry:
-        fn = jax.shard_map(
+        fn = shard_map(
             per_device,
             mesh=mesh,
             in_specs=(P(), batch_spec, P(data_axes)),
@@ -377,7 +386,7 @@ def make_train_step(
         s, m, _ = per_device(state, batch, None)
         return s, m
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device_nocarry,
         mesh=mesh,
         in_specs=(P(), batch_spec),
@@ -505,7 +514,7 @@ def make_eval_step(
         return sums, logits, out_lengths
 
     if meta.has_carry:
-        fn = jax.shard_map(
+        fn = shard_map(
             per_device,
             mesh=mesh,
             in_specs=(P(), P(data_axes), P(data_axes)),
@@ -526,7 +535,7 @@ def make_eval_step(
             )
             return lax.psum(sums, red_axes), logits, out_lengths
 
-        fn = jax.shard_map(
+        fn = shard_map(
             per_device_ctc,
             mesh=mesh,
             in_specs=(P(), P(data_axes)),
@@ -540,7 +549,7 @@ def make_eval_step(
         return m
 
     if seq_axis is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             per_device_nocarry,
             mesh=mesh,
             in_specs=(P(), P(data_axes)),
@@ -566,7 +575,7 @@ def make_eval_step(
                 for k in batch
             }
             cache[key] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     per_device_nocarry,
                     mesh=mesh,
                     in_specs=(P(), spec),

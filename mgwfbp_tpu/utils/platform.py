@@ -57,6 +57,78 @@ def apply_platform_overrides(
     return platform
 
 
+def get_shard_map():
+    """Version-portable `shard_map` (jax >= 0.6 `jax.shard_map`, else
+    `jax.experimental.shard_map.shard_map`).
+
+    The two spellings also renamed the replication-check kwarg
+    (`check_rep` -> `check_vma`); the returned callable accepts EITHER
+    name and translates to whatever the underlying implementation takes,
+    so call sites can be written once against the modern signature.
+    Positional use (`shard_map(f, mesh, in_specs=..., out_specs=...)`)
+    passes through unchanged.
+    """
+    import functools
+    import inspect
+
+    import jax
+
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+    accepted = None
+    has_var_kw = False
+    try:
+        params = inspect.signature(impl).parameters
+        accepted = set(params)
+        has_var_kw = any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
+    except (TypeError, ValueError):  # C-implemented or wrapped oddly
+        pass
+
+    check_names = ("check_vma", "check_rep")
+
+    @functools.wraps(impl)
+    def shard_map(*args, **kwargs):
+        given = [n for n in check_names if n in kwargs]
+        if given and accepted is not None:
+            value = kwargs.pop(given[0])
+            for extra in given[1:]:
+                kwargs.pop(extra)
+            for name in check_names:
+                if name in accepted:
+                    kwargs[name] = value
+                    break
+            else:
+                if has_var_kw:
+                    # a (*args, **kwargs) wrapper may still route the knob
+                    # through; forward the caller's original spelling
+                    kwargs[given[0]] = value
+                # otherwise the check knob no longer exists; drop it
+        return impl(*args, **kwargs)
+
+    return shard_map
+
+
+def axis_size(axis_name) -> int:
+    """Static extent of a bound mesh axis, version-portable.
+
+    `lax.axis_size` only exists on newer jax; `lax.psum(1, axis)` is
+    statically folded to a Python int for a concrete unit operand on every
+    version this repo supports, so it is the fallback. Accepts a single
+    axis name or a tuple (product of extents).
+    """
+    from jax import lax
+
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    size_fn = getattr(lax, "axis_size", None)
+    total = 1
+    for a in axes:
+        total *= int(size_fn(a)) if size_fn is not None else int(lax.psum(1, a))
+    return total
+
+
 def already_initialized_platforms() -> list[str]:
     """Platforms jax has already initialized a backend for (empty = none)."""
     try:

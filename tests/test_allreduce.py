@@ -8,15 +8,18 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from mgwfbp_tpu.parallel.allreduce import (
     arrival_order,
     make_merged_allreduce,
-    merged_psum,
 )
 from mgwfbp_tpu.parallel.costmodel import AlphaBeta
 from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
+from mgwfbp_tpu.utils.platform import get_shard_map
+
+# `from jax import shard_map` only exists on jax >= 0.6; the shim resolves
+# the right implementation (and kwarg spelling) for the running version.
+shard_map = get_shard_map()
 
 
 def _grad_tree(rng):

@@ -20,6 +20,9 @@ from mgwfbp_tpu.parallel.costmodel import (
     topk_time,
 )
 from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
+from mgwfbp_tpu.utils.platform import get_shard_map
+
+shard_map = get_shard_map()
 
 
 @pytest.fixture(scope="module")
@@ -67,7 +70,7 @@ def test_topk_allreduce_identity_when_k_full(mesh):
         return c.allreduce(v, (DATA_AXIS,), mean=True)
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
             check_vma=False,
         )
@@ -91,7 +94,7 @@ def test_topk_sparse_allreduce_keeps_largest(mesh):
     big = jnp.tile(buf, 8)  # (64,) -> each device sees `buf`
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
             check_vma=False,
         )
@@ -116,7 +119,7 @@ def test_rs_ag_comm_op_matches_all_reduce(mesh):
 
     def run(reducer, grads):
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda g: reducer(g), mesh=mesh, in_specs=P(), out_specs=P(),
                 check_vma=False,
             )
@@ -156,7 +159,7 @@ def test_merged_allreduce_with_compressor_end_to_end(mesh):
             return reducer(g)
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
             )
         )(grads)

@@ -59,12 +59,42 @@ def _compiled_text(nsteps, mesh, policy="mgwfbp"):
     return text, reducer
 
 
+def _scan_derived_whiles(text):
+    """HLO while ops whose op_name marks them as lax.scan lowerings (the
+    CPU backend's scatter expansion also emits whiles, carrying the
+    scatter's op_name instead)."""
+    return [
+        m.group(1)
+        for m in re.finditer(r'while[^\n]*op_name="([^"]+)"', text)
+        if m.group(1).endswith("/while") or "/while/" in m.group(1)
+    ]
+
+
+def test_scan_while_filter_positive_control():
+    # the filter must MATCH a genuine lax.scan while — if an XLA upgrade
+    # changes the op_name shape this canary fails instead of the barrier
+    # guard below going silently vacuous
+    def f(x):
+        def body(c, t):
+            return c + t, None
+        out, _ = jax.lax.scan(body, x, jnp.ones((4, 3)))
+        return out
+
+    text = jax.jit(f).lower(jnp.ones((3,))).compile().as_text()
+    assert _scan_derived_whiles(text), "scan-while op_name shape changed"
+
+
 def test_no_loop_barrier_when_nsteps_is_one(mesh):
     text, reducer = _compiled_text(1, mesh)
     # the micro-batch scan must be gone entirely: an HLO while op between
     # backward and the pmeans would serialize all collectives after all
-    # compute (VERDICT r2 Weak #3)
-    assert " while(" not in text and " while " not in text
+    # compute (VERDICT r2 Weak #3). Only SCAN-derived loops are the barrier
+    # this polices (see _scan_derived_whiles; the positive-control test
+    # above keeps the filter honest across XLA upgrades); jax 0.4.x's CPU
+    # backend lowers take_along_axis' transpose as a trip-count-2
+    # scatter-add while that is NOT a collective barrier.
+    scan_loops = _scan_derived_whiles(text)
+    assert not scan_loops, scan_loops[:3]
     # one all-reduce per merge group survives in the optimized module
     n_ar = len(re.findall(r"all-reduce(?:-start)?\(", text))
     assert n_ar >= reducer.schedule.num_groups >= 2

@@ -10,6 +10,9 @@ from jax.sharding import PartitionSpec as P
 
 from mgwfbp_tpu.parallel.mesh import MeshSpec, SEQ_AXIS, make_mesh
 from mgwfbp_tpu.parallel.ringattn import local_attention, ring_attention
+from mgwfbp_tpu.utils.platform import get_shard_map
+
+shard_map = get_shard_map()
 
 
 @pytest.fixture(scope="module")
@@ -34,7 +37,7 @@ def test_ring_matches_local(mesh_seq, causal):
 
     spec = P(None, SEQ_AXIS)  # shard time dim; batch replicated over data
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh_seq, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )
@@ -66,7 +69,7 @@ def test_ring_attention_softmax_normalized(mesh_seq):
 
     spec = P(None, SEQ_AXIS)
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh_seq, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

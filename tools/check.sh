@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Pre-PR gate: ruff -> static analysis -> tier-1 tests (ROADMAP.md).
+# Any stage failing fails the script; ruff is skipped (with a notice) when
+# the binary isn't installed, since the container image doesn't bake it in.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+rc=0
+
+echo "== [1/3] ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check mgwfbp_tpu tests tools bench.py || rc=1
+else
+    echo "ruff not installed; skipping (config lives in pyproject.toml)"
+fi
+
+echo "== [2/3] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
+JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
+
+echo "== [3/3] tier-1 tests =="
+t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
+trap 'rm -f "$t1log"' EXIT                 # must not clobber each other
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee "$t1log"
+t1=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1log" | tr -cd . | wc -c)"
+[ "$t1" -ne 0 ] && rc=1
+
+if [ "$rc" -eq 0 ]; then
+    echo "check.sh: ALL GREEN"
+else
+    echo "check.sh: FAILURES (see above)" >&2
+fi
+exit "$rc"

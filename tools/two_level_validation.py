@@ -62,6 +62,9 @@ def run(ici, dcn, min_log2, max_log2, warmup, iters):
     from mgwfbp_tpu.parallel.costmodel import (
         SampledCost, TwoLevelAlphaBeta, fit_alpha_beta,
     )
+    from mgwfbp_tpu.utils.platform import get_shard_map
+
+    shard_map = get_shard_map()
 
     n = ici * dcn
     devs = np.asarray(jax.devices()[:n]).reshape(ici, dcn)
@@ -71,7 +74,7 @@ def run(ici, dcn, min_log2, max_log2, warmup, iters):
 
     def timed(body):
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body, mesh=mesh, in_specs=P(), out_specs=P(),
                 check_vma=False,
             )
