@@ -37,6 +37,12 @@ def main(argv=None) -> int:
         "--policies", default="wfbp,single,mgwfbp",
         help="comma-separated merge policies to verify (jaxpr pass)",
     )
+    parser.add_argument(
+        "--comm-ops", dest="comm_ops", default="all_reduce,rs_opt_ag",
+        help="comma-separated bucket lowerings to verify; each policy is "
+        "traced under each (rs_opt_ag is verified with global-norm "
+        "clipping on, so the cross-group clip psum is covered too)",
+    )
     parser.add_argument("--warnings-as-errors", action="store_true",
                         help="exit non-zero on warnings too")
     args = parser.parse_args(argv)
@@ -55,8 +61,16 @@ def main(argv=None) -> int:
     if not args.skip_jaxpr:
         from mgwfbp_tpu.analysis.jaxpr_check import verify_train_step
 
+        ops = [c.strip() for c in args.comm_ops.split(",") if c.strip()]
         for policy in [p.strip() for p in args.policies.split(",") if p.strip()]:
-            findings.extend(verify_train_step(args.model, policy))
+            for comm_op in ops:
+                findings.extend(verify_train_step(
+                    args.model, policy, comm_op=comm_op,
+                    # clipping on the sharded path also verifies the
+                    # declared clip-psum scope stays the only extra
+                    # collective
+                    norm_clip=1.0 if comm_op == "rs_opt_ag" else None,
+                ))
 
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = sum(1 for f in findings if f.severity == WARNING)

@@ -48,9 +48,13 @@ CALLBACK_PRIMS = frozenset({
 })
 
 # scopes the train step declares for its OWN auxiliary collectives
-# (train/step.py); anything else collective-shaped must be a merge group
+# (train/step.py); anything else collective-shaped must be a merge group.
+# "sharded_clip_norm" is the rs_opt_ag lowering's one cross-group psum of
+# shard squared norms (global-norm clipping while every bucket is
+# scattered) — parallel/allreduce.py CLIP_NORM_SCOPE, keep in sync.
 DEFAULT_ALLOWED_SCOPES = (
     "metrics_reduce", "bstats_reduce", "flat_grad_reduce",
+    "sharded_clip_norm",
 )
 
 
@@ -161,6 +165,56 @@ def find_donated(closed_jaxpr: Any) -> Optional[tuple[bool, ...]]:
     return None
 
 
+def _check_rs_opt_ag_group(reducer: Any, gi: int, eqns: list, add) -> None:
+    """The rs_opt_ag per-group collective contract: exactly ONE
+    reduce-scatter (the padded grad bucket, at the wire dtype) and ONE
+    all-gather (the UPDATED param shard, 1/world of the padded bucket, at
+    the bucket dtype) under the group's scope — nothing else. A second
+    reduction, a missing gather, or a full-bucket gather operand all mean
+    the sharded-update seam silently degenerated (e.g. back to gathering
+    gradients, or to a replicated update)."""
+    layout = reducer.layout
+    optim = reducer.optim
+    comm_dtype = getattr(reducer, "comm_dtype", None)
+    reductions = [e for e in eqns if e.primitive.name in REDUCTION_PRIMS]
+    gathers = [e for e in eqns if e.primitive.name == "all_gather"]
+    extra = [e for e in eqns if e not in reductions and e not in gathers]
+    if len(reductions) != 1 or len(gathers) != 1:
+        add("SCH001",
+            f"rs_opt_ag group {gi}: expected exactly 1 reduce-scatter + 1 "
+            f"all-gather under its scope, found {len(reductions)} "
+            f"reduction(s) + {len(gathers)} gather(s)")
+        return
+    for e in extra:
+        add("SCH004",
+            f"rs_opt_ag group {gi}: unexpected '{e.primitive.name}' in "
+            "the group scope")
+    padded = optim.padded_size(gi)
+    shard = optim.shard_size(gi)
+    rs, ag = reductions[0], gathers[0]
+    rs_elems = _numel(rs.invars[0].aval)
+    if rs_elems != padded:
+        add("SCH007",
+            f"rs_opt_ag group {gi}: reduce-scatter moves {rs_elems} "
+            f"elements, padded bucket is {padded}")
+    ag_elems = _numel(ag.invars[0].aval)
+    if ag_elems != shard:
+        add("SCH007",
+            f"rs_opt_ag group {gi}: all-gather operand is {ag_elems} "
+            f"elements, the 1/world shard is {shard}")
+    want_wire = comm_dtype if comm_dtype is not None else layout.dtypes[gi]
+    if np.dtype(rs.invars[0].aval.dtype) != np.dtype(want_wire):
+        add("SCH002",
+            f"rs_opt_ag group {gi}: reduce-scatter runs at dtype "
+            f"{np.dtype(rs.invars[0].aval.dtype).name}, wire dtype is "
+            f"{np.dtype(want_wire).name}")
+    if np.dtype(ag.invars[0].aval.dtype) != np.dtype(layout.dtypes[gi]):
+        add("SCH002",
+            f"rs_opt_ag group {gi}: param all-gather runs at dtype "
+            f"{np.dtype(ag.invars[0].aval.dtype).name}, bucket dtype is "
+            f"{np.dtype(layout.dtypes[gi]).name}")
+
+
 def verify_jaxpr_against_reducer(
     closed_jaxpr: Any,
     reducer: Any,
@@ -199,17 +253,21 @@ def verify_jaxpr_against_reducer(
             f"traced step issues {len(groups)} merged collectives, "
             f"schedule promises {layout.num_groups}")
     comm_dtype = getattr(reducer, "comm_dtype", None)
+    comm_op = getattr(reducer, "comm_op", "all_reduce")
     # the hier/rs_ag lowerings pad buckets to scatter-axis divisibility, so
     # their payload check is >=; the monolithic all-reduce is exact; a
     # sparsifying compressor moves k <= n elements chosen at trace time, so
     # no static payload expectation exists and the size check is skipped
-    padded = getattr(reducer, "comm_op", "all_reduce") != "all_reduce"
+    padded = comm_op != "all_reduce"
     sparsified = getattr(reducer, "compressor", None) is not None
     for gi in sorted(groups):
         if gi >= layout.num_groups:
             add("SCH001",
                 f"collective scoped to group {gi} but the layout only has "
                 f"{layout.num_groups} groups")
+            continue
+        if comm_op == "rs_opt_ag":
+            _check_rs_opt_ag_group(reducer, gi, groups[gi], add)
             continue
         eqn = groups[gi][0]  # primary reduction (rs_ag/hier add gathers)
         aval = eqn.invars[0].aval
@@ -235,6 +293,34 @@ def verify_jaxpr_against_reducer(
         add("SCH004",
             f"unexpected '{eqn.primitive.name}' outside declared scopes "
             f"(scope: {_scope_of(eqn) or '<none>'})")
+    # the sharded_clip_norm scope is not a blanket whitelist: it exists
+    # only for the rs_opt_ag lowering, and there its contract is exactly
+    # one psum of the shard squared norms — and only when the spec clips
+    clip_eqns = [
+        e for e in info["allowed"]
+        if "sharded_clip_norm" in _scope_segments(_scope_of(e))
+    ]
+    if comm_op != "rs_opt_ag":
+        for eqn in clip_eqns:
+            add("SCH004",
+                f"'{eqn.primitive.name}' under scope sharded_clip_norm "
+                f"but comm_op is {comm_op!r} (scope reserved for "
+                "rs_opt_ag)")
+    else:
+        clips = getattr(reducer.optim.spec, "norm_clip", None) is not None
+        for eqn in clip_eqns:
+            if eqn.primitive.name != "psum":
+                add("SCH004",
+                    f"'{eqn.primitive.name}' under scope sharded_clip_norm "
+                    "(only the clip-norm psum belongs there)")
+        psums = [e for e in clip_eqns if e.primitive.name == "psum"]
+        want = 1 if clips else 0
+        if len(psums) != want:
+            add("SCH004",
+                f"sharded_clip_norm scope carries {len(psums)} psum(s); "
+                f"the spec (norm_clip="
+                f"{getattr(reducer.optim.spec, 'norm_clip', None)!r}) "
+                f"calls for exactly {want}")
     for eqn in info["callbacks"]:
         add("SCH005",
             f"host callback '{eqn.primitive.name}' in the hot path "
@@ -273,9 +359,11 @@ def trace_train_step(
     model_name: str = "lenet",
     policy: str = "mgwfbp",
     *,
+    comm_op: str = "all_reduce",
     comm_dtype: Any = None,
     donate: bool = True,
     batch_size: int = 16,
+    norm_clip: Optional[float] = None,
 ) -> tuple[Any, Any, list]:
     """Build and trace a representative jitted MG-WFBP train step.
 
@@ -285,13 +373,17 @@ def trace_train_step(
     executes on any device. Exposed separately from `verify_train_step` so
     the analyzer's mutation tests can verify a REAL traced program against
     a deliberately doctored expectation.
+
+    comm_op='rs_opt_ag' traces the sharded-optimizer path (opt state as
+    1/world shard buffers, params gathered post-update); norm_clip then
+    additionally exercises the cross-group clip psum.
     """
     _ensure_cpu_devices()
     import jax
     import jax.numpy as jnp
 
     from mgwfbp_tpu import models as zoo
-    from mgwfbp_tpu.optim import sgd
+    from mgwfbp_tpu.optim import OptimSpec
     from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
     from mgwfbp_tpu.parallel.costmodel import AlphaBeta
     from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
@@ -299,7 +391,8 @@ def trace_train_step(
 
     mesh = make_mesh(MeshSpec(data=len(jax.devices()), seq=1))
     model, meta = zoo.create_model(model_name)
-    tx = sgd(0.1, momentum=0.9)
+    spec = OptimSpec(lr=0.1, kind="sgd", momentum=0.9, norm_clip=norm_clip)
+    tx = spec.make_tx()
     # abstract state: full init math traced, nothing executed
     state = jax.eval_shape(
         lambda: create_train_state(
@@ -310,10 +403,16 @@ def trace_train_step(
     kw: dict[str, Any] = {}
     if policy == "mgwfbp":
         kw = dict(cost_model=AlphaBeta(1e-4, 1e-9))
+    if comm_op == "rs_opt_ag":
+        kw.update(optim_spec=spec, world_size=len(jax.devices()))
     reducer = make_merged_allreduce(
         state.params, axis_name=DATA_AXIS, policy=policy,
-        comm_dtype=comm_dtype, **kw,
+        comm_dtype=comm_dtype, comm_op=comm_op, **kw,
     )
+    if comm_op == "rs_opt_ag":
+        state = state.replace(
+            opt_state=jax.eval_shape(reducer.optim.init)
+        )
     step = make_train_step(model, meta, tx, mesh, reducer, donate=donate)
     batch = {
         "x": jax.ShapeDtypeStruct(
@@ -331,18 +430,23 @@ def verify_train_step(
     model_name: str = "lenet",
     policy: str = "mgwfbp",
     *,
+    comm_op: str = "all_reduce",
     comm_dtype: Any = None,
     donate: bool = True,
     expect_donation: Optional[bool] = None,
     batch_size: int = 16,
+    norm_clip: Optional[float] = None,
 ) -> list[Finding]:
     """Trace one representative jitted train step and verify it."""
     closed, reducer, arr = trace_train_step(
-        model_name, policy, comm_dtype=comm_dtype, donate=donate,
-        batch_size=batch_size,
+        model_name, policy, comm_op=comm_op, comm_dtype=comm_dtype,
+        donate=donate, batch_size=batch_size, norm_clip=norm_clip,
+    )
+    tag = f"{model_name}/{policy}" + (
+        f"/{comm_op}" if comm_op != "all_reduce" else ""
     )
     return verify_jaxpr_against_reducer(
         closed, reducer, arr,
         expect_donation=donate if expect_donation is None else expect_donation,
-        file=f"<train step {model_name}/{policy}>",
+        file=f"<train step {tag}>",
     )

@@ -50,7 +50,10 @@ class TrainConfig:
     compressor: str = "none"  # none | topk
     density: float = 1.0  # kept fraction for sparsifying compressors
     comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR-style RS+AG per
-    # bucket) | hier (two-level ICI+DCN lowering; needs dcn_slices > 1)
+    # bucket) | hier (two-level ICI+DCN lowering; needs dcn_slices > 1) |
+    # rs_opt_ag (ZeRO-1-style: optimizer update runs on the 1/world bucket
+    # shard between reduce-scatter and a param all-gather; opt state stays
+    # device-sharded between steps — needs a bucketing policy, no compressor)
 
     # numerics
     dtype: str = "float32"  # param/compute dtype

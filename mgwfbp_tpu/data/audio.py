@@ -75,14 +75,25 @@ def read_wav(path: str) -> np.ndarray:
 
 
 def load_manifest(path: str) -> list[tuple[str, str]]:
-    """Rows of "wav_path,transcript_path" (reference audio_data manifests)."""
+    """Rows of "wav_path,transcript_path" (reference audio_data manifests).
+
+    Relative entries resolve against the MANIFEST's own directory, so a
+    committed manifest (data/an4_memcheck) reproduces wherever the repo is
+    checked out instead of hardcoding the build machine's absolute layout
+    (ADVICE r5 #3). Absolute entries pass through untouched — the fetch
+    scripts write those for scratch data dirs."""
+    base = os.path.dirname(os.path.abspath(path))
     rows = []
     with open(path) as f:
         for line in f:
             line = line.strip()
             if line:
                 wav, txt = line.split(",")[:2]
-                rows.append((wav, txt))
+                rows.append(tuple(
+                    p if os.path.isabs(p)
+                    else os.path.normpath(os.path.join(base, p))
+                    for p in (wav, txt)
+                ))
     return rows
 
 

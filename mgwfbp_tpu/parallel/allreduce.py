@@ -21,8 +21,10 @@ from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from mgwfbp_tpu.optim import OptimSpec
 from mgwfbp_tpu.parallel import buckets as buckets_lib
 from mgwfbp_tpu.parallel.buckets import BucketLayout, build_layout
 from mgwfbp_tpu.parallel.solver import (
@@ -30,6 +32,7 @@ from mgwfbp_tpu.parallel.solver import (
     MergeSchedule,
     build_schedule,
     check_unique,
+    effective_cost_fn,
     predict_group_times,
     simulate_groups,
 )
@@ -41,6 +44,14 @@ from mgwfbp_tpu.utils.platform import axis_size
 # lowered program ACTUALLY issues against the MergeSchedule that promised
 # them. Keep in sync with analysis/jaxpr_check.py.
 GROUP_SCOPE_PREFIX = "mgwfbp_group"
+
+# Name scope of the ONE extra collective the rs_opt_ag lowering may issue: a
+# cross-group psum of per-shard squared gradient norms, required for
+# global-norm clipping (the clip threshold is a property of the WHOLE grad
+# tree, but each device only holds 1/world of each bucket between the
+# reduce-scatter and the update). analysis/jaxpr_check whitelists exactly
+# this scope; keep the two in sync.
+CLIP_NORM_SCOPE = "sharded_clip_norm"
 
 
 def group_scope_name(gi: int) -> str:
@@ -154,6 +165,471 @@ def _hierarchical_allreduce(
     )
 
 
+# ---------------------------------------------------------------------------
+# Sharded optimizer in the communication path (comm_op='rs_opt_ag').
+#
+# The rs_ag decomposition already splits each bucket all-reduce into
+# reduce-scatter + all-gather; between those two phases every device holds
+# the fully REDUCED 1/world shard of the bucket — the one moment in the step
+# where running the optimizer costs 1/world the FLOPs and optimizer-state
+# HBM traffic of the replicated update (DeAR's fine-grained RS/AG pipeline,
+# arXiv:2302.12445, plus Optimizer Fusion's update-in-the-comm-path
+# locality argument, arXiv:2104.00237). The all-gather then carries updated
+# PARAMS instead of gradients: same wire bytes, and the optimizer state
+# (momentum / Adam moments) never needs to exist outside its shard — a
+# ZeRO-1-style ~1/world optimizer-state memory footprint.
+# ---------------------------------------------------------------------------
+
+
+class ShardedOptState:
+    """Optimizer state of the rs_opt_ag path: per-(slot, group) flat shard
+    buffers of GLOBAL shape (world, shard_len) — sharded over the data axes
+    between steps — plus one replicated step count (lr schedules, Adam bias
+    correction). `slots[s][gi]` mirrors `BucketLayout` group `gi` for
+    params-shaped state leaf `s` (SGD momentum: 1 slot; Adam m/v: 2)."""
+
+    def __init__(self, count, slots):
+        self.count = count
+        self.slots = tuple(tuple(g for g in s) for s in slots)
+
+    def __repr__(self):
+        return (
+            f"ShardedOptState(count={self.count!r}, "
+            f"slots={len(self.slots)}x{len(self.slots[0]) if self.slots else 0})"
+        )
+
+
+jax.tree_util.register_pytree_node(
+    ShardedOptState,
+    lambda s: ((s.count, s.slots), None),
+    lambda _, ch: ShardedOptState(count=ch[0], slots=ch[1]),
+)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ShardedOptimStep:
+    """(layout, optimizer-update-on-flat-buffers) for the rs_opt_ag seam.
+
+    Interprets an elementwise `optim.OptimSpec` (SGD/momentum/Adam/AdamW,
+    coupled or decoupled weight decay, global-norm clipping) on the flat
+    1/world bucket shards the reduce-scatter produces. Per-LEAF
+    hyperparameters (the ndim>1 decay mask) become per-ELEMENT host
+    constants over the padded bucket (`buckets.group_mask_vector`) sliced to
+    the device's shard at trace time, so shard boundaries may cut leaves
+    arbitrarily.
+
+    `world` is static (mesh extent at construction); the traced path
+    re-derives it from the bound axes and refuses to run on a mismatched
+    mesh — a silently wrong shard split would corrupt every parameter.
+    """
+
+    spec: OptimSpec
+    layout: BucketLayout
+    shapes: tuple[tuple[int, ...], ...]  # leaf shapes, arrival order
+    perm: tuple[int, ...]  # tree-position -> arrival-position permutation
+    axes: tuple[str, ...]
+    world: int
+
+    @property
+    def num_slots(self) -> int:
+        return self.spec.num_slots
+
+    def shard_size(self, gi: int) -> int:
+        return buckets_lib.shard_size(self.layout, gi, self.world)
+
+    def padded_size(self, gi: int) -> int:
+        return buckets_lib.padded_group_size(self.layout, gi, self.world)
+
+    def decay_mask_vec(self, gi: int) -> Optional[np.ndarray]:
+        """Padded per-element decay mask for group gi (None = no decay)."""
+        if not self.spec.weight_decay:
+            return None
+        flags = [
+            (len(s) > 1) if self.spec.mask_ndim_gt1 else True
+            for s in self.shapes
+        ]
+        return buckets_lib.group_mask_vector(
+            self.layout, gi, flags, self.shapes, self.world
+        )
+
+    # -- state construction / accounting ---------------------------------
+    def init(self) -> ShardedOptState:
+        """Fresh sharded state (zeros), global (world, shard_len) buffers."""
+        slots = tuple(
+            tuple(
+                jnp.zeros(
+                    (self.world, self.shard_size(gi)), self.layout.dtypes[gi]
+                )
+                for gi in range(self.layout.num_groups)
+            )
+            for _ in range(self.num_slots)
+        )
+        return ShardedOptState(count=jnp.zeros((), jnp.int32), slots=slots)
+
+    def partition_spec(self) -> ShardedOptState:
+        """Pytree of PartitionSpecs matching `init()`'s structure: shard
+        buffers split over the data axes, the count replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        slots = tuple(
+            tuple(P(self.axes) for _ in range(self.layout.num_groups))
+            for _ in range(self.num_slots)
+        )
+        return ShardedOptState(count=P(), slots=slots)
+
+    def state_bytes_per_device(self) -> int:
+        """Optimizer-state bytes each device holds on the sharded path."""
+        per_slot = sum(
+            self.shard_size(gi) * jnp.dtype(self.layout.dtypes[gi]).itemsize
+            for gi in range(self.layout.num_groups)
+        )
+        return self.num_slots * per_slot + 4  # + int32 count
+
+    def replicated_state_bytes(self) -> int:
+        """Bytes of the params-shaped state leaves every device would hold
+        on the replicated path (the 1/world comparison baseline)."""
+        per_slot = sum(
+            self.layout.group_sizes[gi]
+            * jnp.dtype(self.layout.dtypes[gi]).itemsize
+            for gi in range(self.layout.num_groups)
+        )
+        return self.num_slots * per_slot
+
+    # -- checkpoint interchange (host-side, numpy) -----------------------
+    # Checkpoints always store the REPLICATED optax structure, whichever
+    # path wrote them: the sharded layout depends on (mesh extent, merge
+    # schedule), both of which may differ at restore time, while the optax
+    # structure depends only on the optimizer — so gather on save, scatter
+    # on load keeps all_reduce- and rs_opt_ag-run checkpoints freely
+    # interchangeable (and elastic resizes re-scatter through the same
+    # pair).
+
+    def _unpack_slot(self, slot_bufs: Sequence[Any]) -> list[np.ndarray]:
+        """One slot's buffers -> per-leaf arrays in TREE order."""
+        arr: list[Any] = [None] * len(self.shapes)
+        for gi in range(self.layout.num_groups):
+            flat = np.asarray(slot_bufs[gi]).reshape(-1)
+            for i, a in buckets_lib.unpack_group_host(
+                flat, self.layout, gi, self.shapes
+            ).items():
+                arr[i] = a
+        restored: list[Any] = [None] * len(arr)
+        for k, j in enumerate(self.perm):
+            restored[j] = arr[k]
+        return restored
+
+    def _pack_slot(self, tree_leaves: Sequence[Any]) -> tuple[np.ndarray, ...]:
+        """Per-leaf arrays in TREE order -> one slot's (world, shard)
+        buffers."""
+        arr = [np.asarray(tree_leaves[j]) for j in self.perm]
+        return tuple(
+            buckets_lib.pack_group_host(
+                arr, self.layout, gi, self.world
+            ).reshape(self.world, self.shard_size(gi))
+            for gi in range(self.layout.num_groups)
+        )
+
+    def gather(self, state: ShardedOptState, tx: Any, params: Any) -> Any:
+        """Sharded state -> the replicated optax state `tx.init(params)`
+        would produce after the same update history."""
+        treedef = jax.tree_util.tree_structure(params)
+        slot_trees = [
+            jax.tree_util.tree_unflatten(treedef, self._unpack_slot(bufs))
+            for bufs in state.slots
+        ]
+        it = iter(slot_trees)
+        template = tx.init(params)
+        out = _map_params_subtrees(
+            template, params,
+            lambda sub: jax.tree_util.tree_map(
+                lambda ref, new: jnp.asarray(new, ref.dtype), sub, next(it)
+            ),
+        )
+        count = jnp.asarray(np.asarray(state.count))
+        return _map_count_leaves(
+            out, lambda leaf: jnp.asarray(count, leaf.dtype)
+        )
+
+    def scatter(self, opt_state: Any, params: Any) -> ShardedOptState:
+        """Replicated optax state -> the sharded representation."""
+        collected: list[Any] = []
+
+        def collect(sub):
+            collected.append(sub)
+            return sub
+
+        _map_params_subtrees(opt_state, params, collect)
+        if len(collected) != self.num_slots:
+            raise ValueError(
+                f"opt state carries {len(collected)} params-shaped "
+                f"subtree(s), the spec expects {self.num_slots} "
+                f"(kind={self.spec.kind!r}, momentum={self.spec.momentum})"
+            )
+        slots = tuple(
+            self._pack_slot(jax.tree_util.tree_leaves(sub))
+            for sub in collected
+        )
+        counts: list[int] = []
+        _map_count_leaves(
+            opt_state, lambda leaf: counts.append(int(leaf)) or leaf
+        )
+        count = jnp.asarray(counts[0] if counts else 0, jnp.int32)
+        return ShardedOptState(
+            count=count,
+            slots=tuple(
+                tuple(jnp.asarray(b) for b in s) for s in slots
+            ),
+        )
+
+    # -- the fused shard update ------------------------------------------
+    def update_shard(
+        self,
+        gi: int,
+        grad: jax.Array,
+        param: jax.Array,
+        slots_in: Sequence[jax.Array],
+        count: jax.Array,
+        clip_scale: Optional[jax.Array],
+        rank: jax.Array,
+    ) -> tuple[jax.Array, tuple[jax.Array, ...]]:
+        """One group's optimizer step on its shard. Mirrors the optax chain
+        `spec.make_tx()` builds, term for term (see optax.trace /
+        scale_by_adam / add_decayed_weights / scale_by_learning_rate):
+        `count` is the number of COMPLETED optimizer steps (lr schedules
+        read it pre-increment, Adam bias correction post-increment, exactly
+        optax's conventions)."""
+        spec = self.spec
+        g = grad
+        if clip_scale is not None:
+            # clip_scale carries (g_norm, max_norm); mirror optax's exact
+            # arithmetic — lax.select(trigger, t, (t / g_norm) * max_norm)
+            # — so the only clip-path difference vs the replicated chain is
+            # the norm's summation order, not an extra rounding step
+            g_norm, max_norm = clip_scale
+            g = lax.select(
+                jnp.broadcast_to(g_norm < max_norm, g.shape),
+                g,
+                (g / g_norm.astype(g.dtype)) * max_norm.astype(g.dtype),
+            )
+        mask = None
+        if spec.weight_decay:
+            vec = jnp.asarray(self.decay_mask_vec(gi), g.dtype)
+            mask = lax.dynamic_slice_in_dim(
+                vec, rank * self.shard_size(gi), self.shard_size(gi)
+            )
+        lr = spec.learning_rate(count)
+        if spec.kind == "sgd":
+            if spec.weight_decay:
+                g = g + spec.weight_decay * param * mask
+            if spec.momentum:
+                mu = g + spec.momentum * slots_in[0]
+                u = g + spec.momentum * mu if spec.nesterov else mu
+                new_slots = (mu,)
+            else:
+                u, new_slots = g, ()
+        else:  # adam / adamw
+            mu = spec.b1 * slots_in[0] + (1.0 - spec.b1) * g
+            nu = spec.b2 * slots_in[1] + (1.0 - spec.b2) * g * g
+            c = (count + 1).astype(g.dtype)
+            mu_hat = mu / (1.0 - spec.b1**c)
+            nu_hat = nu / (1.0 - spec.b2**c)
+            u = mu_hat / (jnp.sqrt(nu_hat) + spec.eps)
+            if spec.weight_decay:  # decoupled (adamw): after preconditioner
+                u = u + spec.weight_decay * param * mask
+            new_slots = (mu, nu)
+        new_param = param - jnp.asarray(lr, u.dtype) * u
+        return new_param, new_slots
+
+
+def _map_params_subtrees(opt_state: Any, params: Any, fn) -> Any:
+    """Rebuild `opt_state` with every subtree STRUCTURALLY identical to
+    `params` replaced by `fn(subtree)`, in deterministic traversal order.
+
+    This is the generic bridge between an opaque optax state pytree and the
+    sharded representation: the params-shaped subtrees (optax.trace's
+    momentum, scale_by_adam's mu/nu) are exactly the leaves worth sharding,
+    and every elementwise optax transform stores them as such. Scalar
+    state (counts, empty states) passes through untouched."""
+    p_def = jax.tree_util.tree_structure(params)
+
+    def is_mirror(x: Any) -> bool:
+        try:
+            return jax.tree_util.tree_structure(x) == p_def
+        except Exception:
+            return False
+
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state, is_leaf=is_mirror)
+    return jax.tree_util.tree_unflatten(
+        treedef, [fn(l) if is_mirror(l) else l for l in leaves]
+    )
+
+
+def _map_count_leaves(opt_state: Any, fn) -> Any:
+    """Apply fn to every integer scalar leaf (optax step counters)."""
+    def visit(leaf):
+        if (
+            hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.integer)
+            and getattr(leaf, "ndim", None) == 0
+        ):
+            return fn(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(visit, opt_state)
+
+
+def _device_rank(axes: Sequence[str]) -> jax.Array:
+    """Linear index of this device over `axes` (first listed slowest-
+    varying) — the shard-assignment convention of `lax.psum_scatter` /
+    `lax.all_gather` over multiple named axes, verified against both."""
+    r = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        r = r * axis_size(a) + lax.axis_index(a)
+    return r
+
+
+def _chain_token(buf: jax.Array, token) -> jax.Array:
+    """Thread the sequential-ordering token into `buf` (see merged_psum's
+    docstring for why this survives every XLA simplifier pass)."""
+    if token is None or not jnp.issubdtype(buf.dtype, jnp.inexact):
+        return buf
+    clean = jnp.where(jnp.isfinite(token), token, jnp.zeros_like(token))
+    return buf + jnp.zeros((), buf.dtype) * clean.astype(buf.dtype)
+
+
+def merged_rs_opt_ag(
+    grads: Any,
+    params: Any,
+    opt_state: ShardedOptState,
+    layout: BucketLayout,
+    perm: Sequence[int],
+    axis_name: str | tuple[str, ...],
+    optim: ShardedOptimStep,
+    mean: bool = True,
+    comm_dtype: Optional[Any] = None,
+    sequential: bool = True,
+) -> tuple[Any, ShardedOptState]:
+    """Reduce-scatter grads, update the param/opt-state shard, all-gather
+    updated params — one merge group at a time, under the same
+    `mgwfbp_groupNNNN` scopes the other lowerings stamp.
+
+    Three phases, all inside the one jitted step:
+      1. per group: pack grads, (wire-cast,) reduce-scatter over the data
+         axes — after this each device owns the REDUCED mean shard;
+      2. when the spec clips: one cross-group psum of shard squared norms
+         (scope `sharded_clip_norm`) — the only way a global norm exists
+         while every bucket is scattered;
+      3. per group: slice this device's shard of the packed param bucket,
+         run the fused optimizer update against the shard's opt-state
+         buffers, all-gather the UPDATED param shard, unpack.
+
+    The sequential token chain threads through both collective phases, for
+    the same two reasons as merged_psum: it realizes the solver's
+    one-collective-at-a-time link model, and it stops XLA's collective
+    combiners from re-merging the buckets.
+
+    Returns (updated params pytree, new ShardedOptState). Gradients are
+    consumed; callers skip `tx.update` entirely on this path.
+    """
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    world = axis_size(axes)
+    if world != optim.world:
+        raise ValueError(
+            f"rs_opt_ag: mesh extent {world} over {axes} != the "
+            f"ShardedOptimStep's world {optim.world}; rebuild the reducer "
+            "for this mesh"
+        )
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    g_arr = [g_leaves[j] for j in perm]
+    p_arr = [p_leaves[j] for j in perm]
+    shapes = [l.shape for l in g_arr]
+    rank = _device_rank(axes)
+    num_groups = layout.num_groups
+
+    # ---- phase 1: reduce-scatter every group's grad bucket ----
+    g_shards: list[jax.Array] = []
+    token = None
+    for gi in range(num_groups):
+        with jax.named_scope(group_scope_name(gi)):
+            buf = buckets_lib.pack_group(g_arr, layout, gi)
+            orig_dtype = buf.dtype
+            if comm_dtype is not None and buf.dtype != comm_dtype:
+                buf = buf.astype(comm_dtype)
+            if sequential:
+                buf = _chain_token(buf, token)
+            pad = optim.padded_size(gi) - buf.shape[0]
+            if pad:
+                buf = jnp.pad(buf, (0, pad))
+            shard = lax.psum_scatter(
+                buf, axes, scatter_dimension=0, tiled=True
+            )
+            token = shard[0]
+            if shard.dtype != orig_dtype:
+                shard = shard.astype(orig_dtype)
+            if mean:
+                shard = shard / world
+            g_shards.append(shard)
+
+    # ---- phase 2: global-norm clip scale (cross-group psum) ----
+    clip_scale = None
+    if optim.spec.norm_clip is not None:
+        with jax.named_scope(CLIP_NORM_SCOPE):
+            local = sum(
+                jnp.sum(s.astype(jnp.float32) ** 2) for s in g_shards
+            )
+            g_norm = jnp.sqrt(lax.psum(local, axes))
+            # (g_norm, threshold) pair; the shard update applies optax's
+            # exact clip arithmetic (see update_shard)
+            clip_scale = (g_norm, jnp.float32(optim.spec.norm_clip))
+
+    # ---- phase 3: shard update + param all-gather ----
+    out: list[Any] = [None] * len(g_arr)
+    new_slots: list[list[jax.Array]] = [
+        [None] * num_groups for _ in range(optim.num_slots)
+    ]
+    count = opt_state.count
+    for gi in range(num_groups):
+        with jax.named_scope(group_scope_name(gi)):
+            pbuf = buckets_lib.pack_group(p_arr, layout, gi)
+            pad = optim.padded_size(gi) - pbuf.shape[0]
+            if sequential:
+                pbuf = _chain_token(pbuf, token)
+            if pad:
+                pbuf = jnp.pad(pbuf, (0, pad))
+            n = optim.shard_size(gi)
+            p_shard = lax.dynamic_slice_in_dim(pbuf, rank * n, n)
+            slots_in = tuple(
+                opt_state.slots[s][gi].reshape(-1)
+                for s in range(optim.num_slots)
+            )
+            new_p, slots_out = optim.update_shard(
+                gi, g_shards[gi], p_shard, slots_in, count, clip_scale, rank
+            )
+            full = lax.all_gather(new_p, axes, axis=0, tiled=True)
+            # token taken POST-gather (like merged_psum's post-collective
+            # buf[0]): the next group's gather then depends on this one,
+            # which both realizes the serial link model and denies XLA's
+            # AllGatherCombiner the reordering it needs to re-merge buckets
+            token = full[0]
+            if pad:
+                full = full[: layout.group_sizes[gi]]
+            unpacked = buckets_lib.unpack_group(full, layout, gi, shapes)
+            for s in range(optim.num_slots):
+                new_slots[s][gi] = slots_out[s][None, :]
+        for i, a in unpacked.items():
+            out[i] = a
+    restored: list[Any] = [None] * len(g_leaves)
+    for k, j in enumerate(perm):
+        restored[j] = out[k]
+    new_params = jax.tree_util.tree_unflatten(treedef, restored)
+    new_state = ShardedOptState(
+        count=count + 1,
+        slots=tuple(tuple(s) for s in new_slots),
+    )
+    return new_params, new_state
+
+
 def merged_psum(
     tree: Any,
     layout: BucketLayout,
@@ -198,7 +674,8 @@ def merged_psum(
     if comm_op not in ("all_reduce", "rs_ag", "hier"):
         raise ValueError(
             f"unknown comm_op {comm_op!r}; expected 'all_reduce', 'rs_ag' "
-            "or 'hier'"
+            "or 'hier' (the 'rs_opt_ag' lowering consumes params/opt-state "
+            "too — call MergedAllreduce.reduce_and_update)"
         )
     if compressor is not None and comm_op != "all_reduce":
         raise ValueError(
@@ -271,9 +748,17 @@ class MergedAllreduce:
     sequential: bool = True
     comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR decomposition) |
     # hier (two-level ICI+DCN; needs axis_name=(inner_ici, outer_dcn) —
-    # the trainer wires it via --dcn-slices + --comm-op hier)
+    # the trainer wires it via --dcn-slices + --comm-op hier) |
+    # rs_opt_ag (sharded optimizer between RS and AG; needs `optim`)
+    optim: Optional[ShardedOptimStep] = None  # rs_opt_ag only
 
     def __call__(self, grads: Any) -> Any:
+        if self.comm_op == "rs_opt_ag":
+            raise ValueError(
+                "comm_op='rs_opt_ag' folds the optimizer into the "
+                "collective; call reduce_and_update(grads, params, "
+                "opt_state) instead of the grads-only reduction"
+            )
         return merged_psum(
             grads,
             self.layout,
@@ -284,6 +769,29 @@ class MergedAllreduce:
             compressor=self.compressor,
             sequential=self.sequential,
             comm_op=self.comm_op,
+        )
+
+    def reduce_and_update(
+        self, grads: Any, params: Any, opt_state: ShardedOptState
+    ) -> tuple[Any, ShardedOptState]:
+        """The rs_opt_ag step: reduced grads never materialize — params
+        come back updated and the sharded opt state advanced."""
+        if self.comm_op != "rs_opt_ag" or self.optim is None:
+            raise ValueError(
+                "reduce_and_update requires comm_op='rs_opt_ag' (built via "
+                "make_merged_allreduce(..., optim_spec=..., world_size=...))"
+            )
+        return merged_rs_opt_ag(
+            grads,
+            params,
+            opt_state,
+            self.layout,
+            self.perm,
+            self.axis_name,
+            self.optim,
+            mean=self.mean,
+            comm_dtype=self.comm_dtype,
+            sequential=self.sequential,
         )
 
 
@@ -301,6 +809,8 @@ def make_merged_allreduce(
     comm_dtype: Optional[Any] = None,
     compressor: Optional[Any] = None,
     comm_op: str = "all_reduce",
+    optim_spec: Optional[OptimSpec] = None,
+    world_size: Optional[int] = None,
 ) -> MergedAllreduce:
     """Build the merged-allreduce transform for a parameter pytree.
 
@@ -309,6 +819,11 @@ def make_merged_allreduce(
     policy='mgwfbp', falls back to a size-proportional estimate — sizes are
     the dominant term of backward time for conv/dense layers, so the schedule
     degrades gracefully before profiling has run.
+
+    comm_op='rs_opt_ag' additionally needs `optim_spec` (the elementwise
+    optimizer to run on the bucket shards, optim.OptimSpec) and
+    `world_size` (the static extent of the data axes — shard layouts must
+    exist before any mesh axis is bound).
     """
     leaves = jax.tree_util.tree_leaves(params_or_shapes)
     n = len(leaves)
@@ -319,6 +834,16 @@ def make_merged_allreduce(
         all_names = list(names)
     # fail at construction, not at first traced call
     _check_hier_axes(comm_op, axis_name)
+    if comm_op == "rs_opt_ag":
+        if optim_spec is None or world_size is None:
+            raise ValueError(
+                "comm_op='rs_opt_ag' requires optim_spec and world_size"
+            )
+        if compressor is not None:
+            raise ValueError(
+                "comm_op='rs_opt_ag' cannot combine with a sparsifying "
+                "compressor (the shard update needs the dense reduction)"
+            )
     p = arrival_order(n, perm, names=all_names)
     arr = [leaves[j] for j in p]
     names_arr = [all_names[j] for j in p]
@@ -349,7 +874,8 @@ def make_merged_allreduce(
             tb_total = 1e-3  # last-resort scale, no information available
         tb = [tb_total * s.size / total_size for s in specs]
     schedule = build_schedule(
-        specs, tb, policy=policy, cost_model=cost_model, threshold=threshold
+        specs, tb, policy=policy, cost_model=cost_model,
+        threshold=threshold, comm_op=comm_op,
     )
     layout = build_layout(arr, schedule.groups)
     if layout.groups != schedule.groups:
@@ -358,9 +884,10 @@ def make_merged_allreduce(
         # predictions on the groups actually issued.
         schedule = dataclasses.replace(schedule, groups=layout.groups)
         if tb is not None and cost_model is not None:
+            cost_fn = effective_cost_fn(cost_model, comm_op)
             sizes_b = [s.nbytes for s in specs]
             total, nonoverlap, comm = simulate_groups(
-                layout.groups, sizes_b, tb, cost_model.predict,
+                layout.groups, sizes_b, tb, cost_fn,
                 float(getattr(cost_model, "gamma", 0.0)),
                 float(getattr(cost_model, "overlap", 1.0)),
                 float(getattr(cost_model, "pack_beta", 0.0)),
@@ -371,9 +898,22 @@ def make_merged_allreduce(
                 predicted_nonoverlap_time=nonoverlap,
                 predicted_comm_time=comm,
                 predicted_group_times=predict_group_times(
-                    layout.groups, sizes_b, cost_model.predict
+                    layout.groups, sizes_b, cost_fn
                 ),
             )
+    optim = None
+    if comm_op == "rs_opt_ag":
+        axes = (
+            (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        )
+        optim = ShardedOptimStep(
+            spec=optim_spec,
+            layout=layout,
+            shapes=tuple(tuple(int(d) for d in l.shape) for l in arr),
+            perm=tuple(p),
+            axes=axes,
+            world=int(world_size),
+        )
     return MergedAllreduce(
         schedule=schedule,
         layout=layout,
@@ -383,4 +923,5 @@ def make_merged_allreduce(
         comm_dtype=comm_dtype,
         compressor=compressor,
         comm_op=comm_op,
+        optim=optim,
     )

@@ -176,3 +176,73 @@ def unpack_group(
         n = int(np.prod(shape)) if shape else 1
         out[i] = jax.lax.dynamic_slice_in_dim(bucket, off, n).reshape(shape)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Sharded (1/world) bucket views — the ZeRO-1-style layout the rs_opt_ag
+# lowering runs the optimizer on. A group's flat bucket, padded to world
+# divisibility, splits into `world` equal shards; shard r is exactly what
+# `lax.psum_scatter(..., tiled=True)` hands device r, so a packed PARAM or
+# OPT-STATE buffer sliced with the same arithmetic lines up element-for-
+# element with the reduce-scattered gradient shard.
+# ---------------------------------------------------------------------------
+
+
+def padded_group_size(layout: BucketLayout, gi: int, world: int) -> int:
+    """Bucket element count after padding to world divisibility."""
+    n = layout.group_sizes[gi]
+    return n + (-n) % world
+
+
+def shard_size(layout: BucketLayout, gi: int, world: int) -> int:
+    """Per-device element count of one group's shard."""
+    return padded_group_size(layout, gi, world) // world
+
+
+def group_mask_vector(
+    layout: BucketLayout,
+    gi: int,
+    leaf_flags: Sequence[bool],
+    shapes: Sequence[tuple[int, ...]],
+    world: int,
+) -> np.ndarray:
+    """Per-element float32 vector over the PADDED bucket: 1.0 where the
+    owning leaf's flag is set, 0.0 elsewhere (padding included).
+
+    This is how per-LEAF optimizer hyperparameters (the bn/bias weight-decay
+    exclusion, optim.decay_mask) survive flattening into a bucket whose
+    shards cut across leaf boundaries: the mask is a host-side constant the
+    traced update slices alongside the data."""
+    out = np.zeros((padded_group_size(layout, gi, world),), np.float32)
+    for i, off in zip(layout.groups[gi], layout.offsets[gi]):
+        n = int(np.prod(shapes[i])) if shapes[i] else 1
+        if leaf_flags[i]:
+            out[off : off + n] = 1.0
+    return out
+
+
+def pack_group_host(
+    leaves: Sequence[np.ndarray], layout: BucketLayout, gi: int, world: int
+) -> np.ndarray:
+    """Host-side (numpy) padded bucket pack — checkpoint scatter path."""
+    flat = np.concatenate(
+        [np.ravel(np.asarray(leaves[i])) for i in layout.groups[gi]]
+    )
+    pad = (-flat.size) % world
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def unpack_group_host(
+    flat: np.ndarray,
+    layout: BucketLayout,
+    gi: int,
+    shapes: Sequence[tuple[int, ...]],
+) -> dict[int, np.ndarray]:
+    """Host-side (numpy) bucket unpack — checkpoint gather path."""
+    out: dict[int, np.ndarray] = {}
+    for i, off in zip(layout.groups[gi], layout.offsets[gi]):
+        n = int(np.prod(shapes[i])) if shapes[i] else 1
+        out[i] = np.asarray(flat[off : off + n]).reshape(shapes[i])
+    return out

@@ -60,6 +60,15 @@ class AlphaBeta:
     # fusion buffer pays the same copy invisibly). Calibrated by
     # profiling.profile_pack_overhead.
     pack_beta: float = 0.0
+    # per-BUCKET-byte cost of the fused optimizer update the rs_opt_ag
+    # lowering runs on the 1/world shard between the reduce-scatter and the
+    # param all-gather. Sits on the link timeline (the all-gather cannot
+    # start before the shard update finishes), so the solver charges it as
+    # extra per-byte occupancy when comm_op='rs_opt_ag'. A calibration
+    # measures update seconds per SHARD byte and folds the 1/world factor
+    # into this constant; 0.0 (default) prices the update as free — the
+    # elementwise optimizer math is usually negligible next to the wire.
+    update_beta: float = 0.0
 
     def predict(self, nbytes) -> float:
         return self.alpha + self.beta * nbytes
@@ -93,6 +102,7 @@ class SampledCost:
     gamma: float = 0.0
     overlap: float = 1.0
     pack_beta: float = 0.0
+    update_beta: float = 0.0
 
     def __post_init__(self):
         # predict() is the solver's inner-loop cost function (auto_groups
@@ -302,6 +312,7 @@ def interp_alpha_beta(
         return AlphaBeta(
             alpha=base.alpha * scale, beta=base.beta, gamma=base.gamma,
             overlap=base.overlap, pack_beta=base.pack_beta,
+            update_beta=base.update_beta,
         )
     # intermediate count: log2-interpolate between the bracketing entries
     lo = max(k for k in known if k < nworkers)
@@ -312,9 +323,10 @@ def interp_alpha_beta(
     g = table[lo].gamma * (1 - t) + table[hi].gamma * t
     ov = table[lo].overlap * (1 - t) + table[hi].overlap * t
     pb = table[lo].pack_beta * (1 - t) + table[hi].pack_beta * t
+    ub = table[lo].update_beta * (1 - t) + table[hi].update_beta * t
     return AlphaBeta(
         alpha=float(a), beta=float(b), gamma=float(g), overlap=float(ov),
-        pack_beta=float(pb),
+        pack_beta=float(pb), update_beta=float(ub),
     )
 
 
@@ -341,7 +353,7 @@ class ProfileFamily:
             k: (
                 dataclasses.replace(
                     v.ab, gamma=v.gamma, overlap=v.overlap,
-                    pack_beta=v.pack_beta,
+                    pack_beta=v.pack_beta, update_beta=v.update_beta,
                 )
                 if isinstance(v, SampledCost)
                 else v
@@ -517,6 +529,11 @@ class TwoLevelAlphaBeta:
         # the hier lowering packs each bucket once (on the ICI side)
         return self.ici.pack_beta
 
+    @property
+    def update_beta(self) -> float:
+        # the rs_opt_ag shard update runs once, on the inner-level shard
+        return self.ici.update_beta
+
 
 def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
     if isinstance(model, SampledCost):
@@ -528,6 +545,7 @@ def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
             "gamma": model.gamma,
             "overlap": model.overlap,
             "pack_beta": model.pack_beta,
+            "update_beta": model.update_beta,
         }
     return dataclasses.asdict(model)
 
@@ -541,6 +559,7 @@ def _model_from_dict(d: dict) -> "AlphaBeta | SampledCost":
             gamma=d.get("gamma", 0.0),
             overlap=d.get("overlap", 1.0),
             pack_beta=d.get("pack_beta", 0.0),
+            update_beta=d.get("update_beta", 0.0),
         )
     d = {k: v for k, v in d.items() if k != "kind"}
     return AlphaBeta(**d)

@@ -55,8 +55,11 @@ def init_distributed(
     """
     explicit = coordinator_address is not None or process_id is not None
     if num_processes is None:
-        env = os.environ.get("MGWFBP_NUM_PROCESSES")
-        if env is not None:
+        # empty/whitespace counts as unset: launcher scripts export the var
+        # from possibly-unset shell variables, and int("") would crash an
+        # otherwise valid single-host run
+        env = (os.environ.get("MGWFBP_NUM_PROCESSES") or "").strip()
+        if env:
             num_processes = int(env)
         elif explicit:
             raise ValueError(

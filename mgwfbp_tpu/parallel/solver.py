@@ -43,6 +43,26 @@ from mgwfbp_tpu.parallel.costmodel import AlphaBeta, TwoLevelAlphaBeta
 CostFn = Callable[[float], float]  # bytes -> seconds
 
 
+def effective_cost_fn(cost_model, comm_op: str = "all_reduce") -> CostFn:
+    """Per-bucket link-occupancy predictor for a lowering.
+
+    For the plain collectives this is `cost_model.predict`. The rs_opt_ag
+    lowering inserts the fused shard optimizer update BETWEEN the
+    reduce-scatter and the param all-gather — the gather cannot start
+    before the update finishes, so the update's duration
+    (`update_beta * bucket_bytes`, see costmodel.AlphaBeta.update_beta)
+    rides the same serial timeline the merge rule and the simulator reason
+    about. Keeping the term inside the cost function means every consumer
+    (the mgwfbp scan, auto's argmin, predicted_group_times) prices the
+    update-in-the-middle consistently without growing their signatures.
+    """
+    ub = float(getattr(cost_model, "update_beta", 0.0))
+    if comm_op != "rs_opt_ag" or ub == 0.0:
+        return cost_model.predict
+    base = cost_model.predict
+    return lambda nbytes: base(nbytes) + ub * nbytes
+
+
 @dataclasses.dataclass(frozen=True)
 class LayerSpec:
     """One gradient tensor, in arrival order."""
@@ -365,6 +385,7 @@ def build_schedule(
     policy: str = "mgwfbp",
     cost_model: AlphaBeta | TwoLevelAlphaBeta | None = None,
     threshold: int = 0,
+    comm_op: str = "all_reduce",
 ) -> MergeSchedule:
     """Build a MergeSchedule for gradient tensors in arrival order.
 
@@ -373,10 +394,15 @@ def build_schedule(
     cost_model), 'threshold', 'single', or 'wfbp' (no merging). Mirrors the
     reference's policy dispatch (distributed_optimizer.py:263-270: adaptive
     iff ADAPTIVE_MERGE and layerwise_times available, else threshold).
+
+    comm_op: the lowering the schedule will be issued as; 'rs_opt_ag' adds
+    the update-in-the-middle term to every per-bucket cost prediction
+    (`effective_cost_fn`) so the schedule still describes the wire.
     """
     sizes = [l.size for l in layers]
     names = tuple(l.name for l in layers)
     nbytes = [l.nbytes for l in layers]
+    cost_fn = effective_cost_fn(cost_model, comm_op) if cost_model else None
     gamma = float(getattr(cost_model, "gamma", 0.0)) if cost_model else 0.0
     overlap = (
         float(getattr(cost_model, "overlap", 1.0)) if cost_model else 1.0
@@ -393,7 +419,7 @@ def build_schedule(
             sizes,
             tb,
             alpha=cost_model.alpha,
-            cost=cost_model.predict,
+            cost=cost_fn,
             itemsize=[l.itemsize for l in layers],
             gamma=gamma,
         )
@@ -404,7 +430,7 @@ def build_schedule(
             sizes,
             tb,
             alpha=cost_model.alpha,
-            cost=cost_model.predict,
+            cost=cost_fn,
             itemsize=[l.itemsize for l in layers],
             gamma=gamma,
             overlap=overlap,
@@ -421,9 +447,9 @@ def build_schedule(
 
     if tb is not None and cost_model is not None and len(layers):
         total, nonoverlap, comm = simulate_groups(
-            groups, nbytes, tb, cost_model.predict, gamma, overlap, pack_beta
+            groups, nbytes, tb, cost_fn, gamma, overlap, pack_beta
         )
-        group_times = predict_group_times(groups, nbytes, cost_model.predict)
+        group_times = predict_group_times(groups, nbytes, cost_fn)
     else:
         total = nonoverlap = comm = float("nan")
         group_times = ()

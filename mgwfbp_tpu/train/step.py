@@ -243,6 +243,16 @@ def make_train_step(
     'single'; true WFBP baseline is policy 'wfbp'; None is "let XLA fuse",
     the ORIGINAL_HOROVOD-style oracle, SURVEY.md §5 config system).
 
+    A reducer built with comm_op='rs_opt_ag' changes the step's optimizer
+    contract: the reduced gradients never materialize — each merge group is
+    reduce-scattered, the optimizer updates the 1/world param+opt-state
+    bucket shard between the collective phases, and the all-gather carries
+    updated PARAMS (`tx.update` is skipped entirely; `tx` must be the optax
+    twin of the reducer's OptimSpec). state.opt_state must then be the
+    reducer's `ShardedOptState` (reducer.optim.init() / .scatter()), and it
+    stays device-sharded across steps: its buffers ride in/out of the
+    shard_map with P(data_axes) specs instead of replicated P().
+
     seq_axis: sequence-parallel mesh axis for lm models whose time dimension
     is sharded (ring attention, parallel.ringattn). Batch x/y get spec
     P(None, data, seq); gradients/metrics reduce over BOTH axes (each seq
@@ -270,6 +280,19 @@ def make_train_step(
         (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     )
     red_axes = data_axes if seq_axis is None else data_axes + (seq_axis,)
+    sharded_opt = (
+        reducer is not None and reducer.comm_op == "rs_opt_ag"
+    )
+    # state specs: everything replicated EXCEPT the sharded opt-state
+    # buffers on the rs_opt_ag path (P over the reduction axes, matching
+    # the shard each device's reduce-scatter owns)
+    if sharded_opt:
+        state_spec = TrainState(
+            step=P(), params=P(), batch_stats=P(),
+            opt_state=reducer.optim.partition_spec(), rng=P(),
+        )
+    else:
+        state_spec = P()
 
     def per_device(state: TrainState, batch, carry):
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -338,7 +361,13 @@ def make_train_step(
         # grad reductions live under the reducer's per-group scopes (or
         # "flat_grad_reduce"); the metrics/BN-stats pmeans are declared
         # auxiliary so the verifier can tell them from hot-path strays.
-        if reducer is not None:
+        if sharded_opt:
+            # rs_opt_ag: reduction and optimizer are one fused phase —
+            # params come back already updated, tx.update never runs
+            new_params, new_opt_state = reducer.reduce_and_update(
+                grads, state.params, state.opt_state
+            )
+        elif reducer is not None:
             grads = reducer(grads)
         else:
             with jax.named_scope("flat_grad_reduce"):
@@ -351,8 +380,11 @@ def make_train_step(
         if jax.tree_util.tree_leaves(bstats):
             with jax.named_scope("bstats_reduce"):
                 bstats = lax.pmean(bstats, red_axes)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if not sharded_opt:
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -371,8 +403,8 @@ def make_train_step(
         fn = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(), batch_spec, P(data_axes)),
-            out_specs=(P(), P(), P(data_axes)),
+            in_specs=(state_spec, batch_spec, P(data_axes)),
+            out_specs=(state_spec, P(), P(data_axes)),
             check_vma=False,
         )
 
@@ -389,8 +421,8 @@ def make_train_step(
     fn = shard_map(
         per_device_nocarry,
         mesh=mesh,
-        in_specs=(P(), batch_spec),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
 
@@ -436,6 +468,13 @@ def make_eval_step(
     red_axes = data_axes if seq_axis is None else data_axes + (seq_axis,)
     if seq_axis is not None and meta.has_carry:
         raise ValueError("seq-sharded eval requires a carry-free lm model")
+
+    def _strip_opt(state: TrainState) -> TrainState:
+        # eval only reads params/batch_stats; dropping the opt state keeps
+        # the replicated P() in-spec honest when the train path keeps it
+        # device-sharded (rs_opt_ag) — otherwise every eval dispatch would
+        # silently all-gather the whole optimizer state
+        return state.replace(opt_state=())
 
     def _c(tree):
         if compute_dtype is None:
@@ -521,7 +560,10 @@ def make_eval_step(
             out_specs=(P(), P(data_axes)),
             check_vma=False,
         )
-        return jax.jit(fn)
+        jitted = jax.jit(fn)
+        return lambda state, batch, carry: jitted(
+            _strip_opt(state), batch, carry
+        )
 
     if meta.task == "ctc":
         # decode outputs stay sharded on the data axis; loss sums replicate
@@ -542,7 +584,8 @@ def make_eval_step(
             out_specs=(P(), P(data_axes), P(data_axes)),
             check_vma=False,
         )
-        return jax.jit(fn)
+        jitted = jax.jit(fn)
+        return lambda state, batch: jitted(_strip_opt(state), batch)
 
     def per_device_nocarry(state, batch):
         m, _ = per_device(state, batch, None)
@@ -556,7 +599,8 @@ def make_eval_step(
             out_specs=P(),
             check_vma=False,
         )
-        return jax.jit(fn)
+        jitted = jax.jit(fn)
+        return lambda state, batch: jitted(_strip_opt(state), batch)
 
     # seq-sharded eval: per-key specs — rank-1 leaves (valid) shard the
     # batch dim only, rank-2 token arrays shard (batch, time); built lazily
@@ -564,6 +608,7 @@ def make_eval_step(
     cache: dict = {}
 
     def call(state, batch):
+        state = _strip_opt(state)
         key = tuple(sorted(batch))
         if key not in cache:
             spec = {

@@ -151,6 +151,23 @@ class Trainer:
         self._eval_step_compiled = False
         self._profile_backward_enabled = profile_backward
         self.reducer = self._build_reducer(profile_backward)
+        if self._sharded_opt:
+            # rs_opt_ag: the optimizer state lives as 1/world bucket shards
+            # on each device from here on; it only returns to the
+            # replicated optax form at checkpoint boundaries (gather) and
+            # elastic resizes (gather -> re-scatter on the new layout)
+            self.state = self.state.replace(
+                opt_state=self.reducer.optim.init()
+            )
+            self.log.info(
+                "sharded optimizer (rs_opt_ag): opt-state %d B/device vs "
+                "%d B replicated (%.2fx reduction over %d workers)",
+                self.reducer.optim.state_bytes_per_device(),
+                self.reducer.optim.replicated_state_bytes(),
+                self.reducer.optim.replicated_state_bytes()
+                / max(self.reducer.optim.state_bytes_per_device(), 1),
+                self.reducer.optim.world,
+            )
         if self.reducer is not None:
             detail = self.reducer.schedule.policy_detail
             self.log.info(
@@ -168,6 +185,42 @@ class Trainer:
         self.iteration = 0
         self.carry = None
         self._maybe_resume()
+
+    # ------------------------------------------------------------------
+    @property
+    def _sharded_opt(self) -> bool:
+        """True when the optimizer state is device-sharded (rs_opt_ag)."""
+        return (
+            getattr(self, "reducer", None) is not None
+            and self.reducer.comm_op == "rs_opt_ag"
+        )
+
+    def _replicated_template_state(self):
+        """TrainState in checkpoint-interchange form: the replicated optax
+        opt_state structure both comm paths save/restore through."""
+        if not self._sharded_opt:
+            return self.state
+        return self.state.replace(opt_state=self.tx.init(self.state.params))
+
+    def _to_checkpoint_state(self, state):
+        """Gather the sharded opt state into the replicated optax form."""
+        if not self._sharded_opt:
+            return state
+        return state.replace(
+            opt_state=self.reducer.optim.gather(
+                state.opt_state, self.tx, state.params
+            )
+        )
+
+    def _from_checkpoint_state(self, state):
+        """Scatter a replicated optax opt state onto the current layout."""
+        if not self._sharded_opt:
+            return state
+        return state.replace(
+            opt_state=self.reducer.optim.scatter(
+                state.opt_state, state.params
+            )
+        )
 
     # ------------------------------------------------------------------
     def _build_loaders(self):
@@ -200,8 +253,12 @@ class Trainer:
         CONTINUE from its pre-resize position instead of re-deriving the
         epoch from the carried-over step count with the new divisor."""
         config = self.config
-        self.tx, self.epoch_schedule = make_optimizer(
+        # the OptimSpec twin rides along for the rs_opt_ag path: the
+        # sharded update interprets the same fields the optax chain was
+        # built from, so the two representations cannot drift
+        self.tx, self.epoch_schedule, self.optim_spec = make_optimizer(
             config.lr,
+            return_spec=True,
             momentum=config.momentum,
             weight_decay=config.weight_decay,
             lr_schedule=config.lr_schedule,
@@ -329,6 +386,11 @@ class Trainer:
                 f"(seq={self.seq_size}), have {avail}"
             )
         old = self.data_size
+        # sharded opt state (rs_opt_ag) is laid out for the OLD (world,
+        # merge schedule); gather it to the replicated interchange form
+        # while the old reducer still describes it — re-scattered onto the
+        # new layout after the reducer is re-solved below
+        self.state = self._to_checkpoint_state(self.state)
         # advance the LR-schedule anchor to the CURRENT epoch position under
         # the OLD loader length before anything is rebuilt, so the schedule
         # continues smoothly across the resize instead of jumping when the
@@ -358,6 +420,7 @@ class Trainer:
         # is unchanged, so the existing opt_state (momentum) carries over
         self._build_optimizer()
         self.reducer = self._build_reducer(self._profile_backward_enabled)
+        self.state = self._from_checkpoint_state(self.state)
         self._build_steps()
         # the run tag changed with nworkers: re-point log/checkpoint/event
         # sinks so post-resize output is found by a relaunch at the new size
@@ -408,6 +471,15 @@ class Trainer:
                 f"got dcn={self.dcn_size}, seq={self.seq_size}"
             )
         if cfg.policy in ("none", "xla"):
+            if cfg.comm_op == "rs_opt_ag":
+                # the sharded optimizer NEEDS the bucketed lowering (it
+                # runs inside the per-group RS/AG seam); silently falling
+                # back to replicated updates would misreport memory wins
+                raise ValueError(
+                    "--comm-op rs_opt_ag requires a merge policy "
+                    "(mgwfbp/auto/threshold/single/wfbp); policy "
+                    f"{cfg.policy!r} issues no bucket collectives"
+                )
             # the ORIGINAL_HOROVOD-style oracle: one pmean per grad leaf
             # fused at XLA's discretion (reference settings.py:34 A/B switch)
             return None
@@ -416,11 +488,20 @@ class Trainer:
             # reference's single-process path runs WITHOUT the distributed
             # optimizer (dl_trainer.py train_with_single, :956-984); a
             # merge schedule here would only add no-op collective dispatch
+            # (rs_opt_ag falls back to the replicated optimizer too: with
+            # world == 1 a "shard" IS the full state, nothing is saved)
             self.log.info(
                 "single device: skipping merged-allreduce scheduling "
                 "(policy %s inert, reference single-path parity)", cfg.policy,
             )
             return None
+        if cfg.comm_op == "rs_opt_ag" and cfg.compressor not in (
+            None, "", "none"
+        ):
+            raise ValueError(
+                "--comm-op rs_opt_ag cannot combine with --compressor "
+                "(the shard update needs the dense reduction)"
+            )
         if cfg.comm_profile:
             from mgwfbp_tpu.parallel.costmodel import resolve_profile
 
@@ -515,6 +596,10 @@ class Trainer:
             comm_dtype=comm_dtype,
             compressor=compressor,
             comm_op=cfg.comm_op,
+            optim_spec=(
+                self.optim_spec if cfg.comm_op == "rs_opt_ag" else None
+            ),
+            world_size=self.data_size * self.seq_size,
         )
 
     def _profile_backward(self) -> Optional[list[float]]:
@@ -881,8 +966,15 @@ class Trainer:
 
     def save(self, epoch: int) -> None:
         if self.checkpointer is not None:
+            # sharded opt state is gathered to the replicated optax form on
+            # the way out: checkpoints stay interchangeable between comm
+            # paths, mesh extents, and merge schedules
             self.checkpointer.save(
-                Snapshot(state=self.state, epoch=epoch, iteration=self.iteration)
+                Snapshot(
+                    state=self._to_checkpoint_state(self.state),
+                    epoch=epoch,
+                    iteration=self.iteration,
+                )
             )
 
     def close(self) -> None:
@@ -901,7 +993,7 @@ class Trainer:
 
         ckpt = Checkpointer(directory)
         try:
-            snap = ckpt.restore(self.state, epoch=epoch)
+            snap = ckpt.restore(self._replicated_template_state(), epoch=epoch)
         finally:
             ckpt.close()
         if snap is None:
@@ -917,12 +1009,16 @@ class Trainer:
     def _maybe_resume(self) -> None:
         snap = None
         if self.checkpointer is not None:
-            snap = self.checkpointer.restore(self.state)
+            # checkpoints carry the replicated interchange form; restore
+            # into that template, then re-scatter for the sharded path
+            snap = self.checkpointer.restore(self._replicated_template_state())
         if snap is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            self.state = jax.device_put(
-                snap.state, NamedSharding(self.mesh, PartitionSpec())
+            self.state = self._from_checkpoint_state(
+                jax.device_put(
+                    snap.state, NamedSharding(self.mesh, PartitionSpec())
+                )
             )
             self.start_epoch = snap.epoch + 1
             self.iteration = snap.iteration

@@ -84,11 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kept-fraction for sparsifying compressors; 0 = "
                         "auto (cost-model chooser, may fall back to dense)")
     p.add_argument("--comm-op", dest="comm_op", default=None,
-                   choices=["all_reduce", "rs_ag", "hier"],
+                   choices=["all_reduce", "rs_ag", "hier", "rs_opt_ag"],
                    help="bucket collective: monolithic all-reduce, "
-                        "reduce-scatter + all-gather (DeAR-style), or the "
+                        "reduce-scatter + all-gather (DeAR-style), the "
                         "hierarchical two-level ICI+DCN lowering (requires "
-                        "--dcn-slices > 1)")
+                        "--dcn-slices > 1), or reduce-scatter + SHARDED "
+                        "optimizer update + param all-gather (ZeRO-1-style "
+                        "1/world optimizer state; same wire bytes as rs_ag)")
     p.add_argument("--dcn-slices", dest="dcn_slices", type=int, default=None,
                    help="slices of a multi-slice pod: adds an outer "
                         "data-parallel mesh axis whose collectives cross "
@@ -137,11 +139,23 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
 
     apply_platform_overrides()
+    env_procs = os.environ.get("MGWFBP_NUM_PROCESSES", "").strip()
+    try:
+        # =1 is a single-host launch: init_distributed ignores it (its own
+        # `num_processes <= 1` check), so treating it as a multi-host
+        # signal here would only skip the preflight probe (ADVICE r5 #1);
+        # empty stays single-host, garbage fails HERE with a clear message
+        # instead of deep inside init_distributed
+        env_multi = bool(env_procs) and int(env_procs) > 1
+    except ValueError:
+        raise SystemExit(
+            f"MGWFBP_NUM_PROCESSES={env_procs!r} is not an integer"
+        ) from None
     multi_host = bool(
         args.coordinator
         or args.num_processes
         or args.process_id is not None
-        or os.environ.get("MGWFBP_NUM_PROCESSES")
+        or env_multi
     )
     if not multi_host:
         # fail fast on a wedged device grant instead of hanging in PJRT

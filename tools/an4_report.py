@@ -27,11 +27,14 @@ ARTIFACT = os.path.join(
     "profiles", "an4_real_audio.json",
 )
 
-# loss may be nan/inf on a diverged run — such epochs must appear in the
-# audit trajectory, not silently vanish
+# loss may be nan/inf on a diverged run, negative or scientific-notation on
+# exotic configs — every such epoch must appear in the audit trajectory,
+# not silently vanish because the number's spelling fell outside the
+# pattern (ADVICE r5 #4)
+_NUM = r"-?(?:[\d.]+(?:e-?\d+)?|nan|inf)"
 _EVAL = re.compile(
-    r"epoch (\d+) eval: loss ([\d.]+|nan|inf), count [\d.]+, "
-    r"wer ([\d.]+|nan|inf)"
+    rf"epoch (\d+) eval: loss ({_NUM}), count {_NUM}, "
+    rf"wer ({_NUM})"
 )
 
 

@@ -228,13 +228,19 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
     for p in policies:
         if p == best:
             continue
+        # deltas are ROUNDED FIRST and every derived verdict field computed
+        # from the rounded values: the artifact persists only 6-decimal
+        # deltas, so a reader (tests/test_profiles.py pins this) must be
+        # able to recompute slower_in_every_round / sign_test_p exactly —
+        # a raw +3e-9 delta that rounds to 0.0 would otherwise publish a
+        # "slower in every round" verdict its own artifact contradicts
         dl = [
-            runs[p]["windows"][i] - runs[best]["windows"][i]
+            round(runs[p]["windows"][i] - runs[best]["windows"][i], 6)
             for i in range(len(runs[p]["windows"]))
         ]
         md = _st.median(dl)
         entry = {
-            "per_round_delta_s": [round(d, 6) for d in dl],
+            "per_round_delta_s": dl,
             "median_delta_s": round(md, 6),
             "median_delta_frac_of_step": round(md / med[best], 4),
             # magnitude-free evidence: a row slower than the winner in
@@ -245,6 +251,7 @@ def run_grid(model_name, batch, nsteps, comm_profile, iters, warmup,
             # row's, so the 3x-median bound alone calls everything a tie).
             # One-sided binomial tail for the OBSERVED positive count:
             # P(X >= k | n, 0.5) — 0.5**n only when slower in all rounds.
+            # Both fields derive from the ROUNDED dl above (ADVICE r5 #2).
             "slower_in_every_round": all(d > 0 for d in dl),
             "sign_test_p": round(_binom_tail_p(
                 sum(1 for d in dl if d > 0), len(dl)
