@@ -29,10 +29,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--no-gamma", action="store_true",
                    help="skip the bucket-path microbenches: the "
-                        "per-collective overhead (gamma) fit AND the "
-                        "per-byte bucketization (pack_beta) fit — both "
-                        "save as 0.0, reverting the solver to the pure "
-                        "alpha-beta objective")
+                        "per-collective overhead (gamma) fit, the "
+                        "per-byte bucketization (pack_beta) fit AND the "
+                        "rs_opt_ag update-in-the-middle (update_beta) fit "
+                        "— all save as 0.0, reverting the solver to the "
+                        "pure alpha-beta objective")
     p.add_argument("--no-overlap", action="store_true",
                    help="skip the comm/compute overlap-capability probe")
     p.add_argument("--gamma-total-log2", type=int, default=22,
@@ -77,6 +78,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         profile_group_overhead,
         profile_overlap_capability,
         profile_pack_overhead,
+        profile_update_beta,
     )
 
     import jax
@@ -97,8 +99,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         if not args.no_overlap:
             overlap = profile_overlap_capability(mesh)
         pack_beta = 0.0
+        update_beta = 0.0
         if not args.no_gamma:  # same bucket-path microbench family
             pack_beta = profile_pack_overhead(mesh)
+            # the rs_opt_ag update-in-the-middle term (ROADMAP PR-2
+            # follow-up): rs_ag vs rs_opt_ag on an identical payload
+            update_beta = profile_update_beta(mesh)
         # the sampled curve (not just the 2-parameter fit) is the persisted
         # predictor: one flat beta cannot describe payload-dependent
         # per-byte cost (cache regimes on CPU, DMA pipelining on TPU)
@@ -109,6 +115,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             gamma=gamma,
             overlap=overlap,
             pack_beta=pack_beta,
+            update_beta=update_beta,
         )
         return model, prof, gsamples
 
@@ -136,13 +143,14 @@ def main(argv: Optional[list[str]] = None) -> int:
             entries[n] = AlphaBeta(
                 alpha=ab.alpha, beta=ab.beta, gamma=measured.gamma,
                 overlap=measured.overlap, pack_beta=measured.pack_beta,
+                update_beta=measured.update_beta,
             )
         out_model = ProfileFamily(entries=entries)
         meta["measured_fields"] = {
             str(avail): "all (sampled curve + gamma + pack_beta + overlap)",
             **{
-                str(n): "gamma, pack_beta, overlap (chip-measured at "
-                        f"world={avail})"
+                str(n): "gamma, pack_beta, update_beta, overlap "
+                        f"(chip-measured at world={avail})"
                 for n in prior_sizes
             },
         }
@@ -162,6 +170,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             "gamma_s": measured.gamma,
             "overlap": measured.overlap,
             "pack_beta_s_per_byte": measured.pack_beta,
+            "update_beta_s_per_byte": measured.update_beta,
             "prior_extended": prior_sizes,
             "out": args.out,
         }
@@ -184,6 +193,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "gamma_s": model.gamma,
                 "overlap": model.overlap,
                 "pack_beta_s_per_byte": model.pack_beta,
+                "update_beta_s_per_byte": model.update_beta,
             }
         out_model = ProfileFamily(entries=entries)
         meta["world_sizes"] = extents
@@ -201,6 +211,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             "gamma_s": out_model.gamma,
             "overlap": out_model.overlap,
             "pack_beta_s_per_byte": out_model.pack_beta,
+            "update_beta_s_per_byte": out_model.update_beta,
             "samples": len(prof.sizes_bytes),
             "out": args.out,
         }

@@ -46,6 +46,16 @@ class TrainConfig:
     connection: str = "ici"  # cost-model link class (settings.py CONNECTION)
     comm_profile: Optional[str] = None  # path to calibrated alpha-beta json
 
+    # closed-loop schedule autotuner (parallel/autotune.py): race verified
+    # candidate schedules for warmup+k REAL steps each on the live jitted
+    # step, refit the cost model from the measurements, commit the measured
+    # argmin, persist it in the schedule cache
+    autotune: bool = False
+    autotune_steps: int = 3  # timed steps per candidate (k; +1 warmup/compile)
+    autotune_candidates: int = 6  # frontier cap (incumbent always raced too)
+    schedule_cache: Optional[str] = None  # cache dir; default
+    # profiles/schedule_cache (keyed by model/world/comm_op/dtype)
+
     # gradient compression seam (reference compression.py, --compressor/--density)
     compressor: str = "none"  # none | topk
     density: float = 1.0  # kept fraction for sparsifying compressors

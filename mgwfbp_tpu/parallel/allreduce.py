@@ -35,6 +35,7 @@ from mgwfbp_tpu.parallel.solver import (
     effective_cost_fn,
     predict_group_times,
     simulate_groups,
+    size_prior_tb,
 )
 from mgwfbp_tpu.utils.platform import axis_size
 
@@ -811,6 +812,8 @@ def make_merged_allreduce(
     comm_op: str = "all_reduce",
     optim_spec: Optional[OptimSpec] = None,
     world_size: Optional[int] = None,
+    groups: Optional[Sequence[Sequence[int]]] = None,
+    policy_detail: Optional[str] = None,
 ) -> MergedAllreduce:
     """Build the merged-allreduce transform for a parameter pytree.
 
@@ -824,6 +827,10 @@ def make_merged_allreduce(
     optimizer to run on the bucket shards, optim.OptimSpec) and
     `world_size` (the static extent of the data axes — shard layouts must
     exist before any mesh axis is bound).
+
+    groups: an EXPLICIT arrival-order grouping that bypasses the policy
+    solve (autotuner candidates / schedule-cache hits; see
+    `solver.build_schedule`), labeled by `policy_detail`.
     """
     leaves = jax.tree_util.tree_leaves(params_or_shapes)
     n = len(leaves)
@@ -859,23 +866,15 @@ def make_merged_allreduce(
         for nm, l in zip(names_arr, arr)
     ]
     if policy in ("mgwfbp", "auto") and tb is None:
-        # Fallback prior when no measured profile exists: SHAPE from
-        # parameter volume, SCALE from the cost model — total backward time
-        # taken as the predicted time to all-reduce the whole model once
-        # (the regime where merging decisions matter; if compute is far
-        # cheaper than comm the solver converges to one group, if far more
-        # expensive to per-layer groups — both safe). A measured tb
-        # (Trainer._profile_backward) always takes precedence.
-        total_size = float(sum(s.size for s in specs)) or 1.0
-        total_bytes = float(sum(s.nbytes for s in specs))
-        if cost_model is not None:
-            tb_total = float(cost_model.predict(total_bytes))
-        else:
-            tb_total = 1e-3  # last-resort scale, no information available
-        tb = [tb_total * s.size / total_size for s in specs]
+        # Fallback prior when no measured profile exists (solver.
+        # size_prior_tb: shape from parameter volume, scale from the cost
+        # model). A measured tb (Trainer._profile_backward) always takes
+        # precedence.
+        tb = size_prior_tb(specs, cost_model)
     schedule = build_schedule(
         specs, tb, policy=policy, cost_model=cost_model,
         threshold=threshold, comm_op=comm_op,
+        groups=groups, policy_detail=policy_detail,
     )
     layout = build_layout(arr, schedule.groups)
     if layout.groups != schedule.groups:

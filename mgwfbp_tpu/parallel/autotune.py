@@ -1,0 +1,297 @@
+"""Closed-loop schedule autotuner: race candidate schedules on the live job.
+
+MG-WFBP's optimality claim holds only as well as its inputs: the per-layer
+backward times tb and the alpha-beta comm model (arXiv:1811.11141). The
+solver is open-loop — `mgwfbp_tpu.calibrate` microbenchmarks the constants
+out-of-band and the schedule is frozen before the first real step — so the
+solver optimizes a MODEL of the step, never the step itself. DeAR
+(arXiv:2302.12445) shows the practical win comes from tuning the pipelining
+knobs against measured step times on the live job; this module closes that
+loop during the first few real training steps.
+
+The loop (`Trainer.autotune` owns the live pieces — steps, state, data,
+hot-swap; everything schedule-shaped and cache-shaped lives here):
+
+  1. frontier — `solver.schedule_frontier` enumerates the solved schedule's
+     neighbourhood (merge-threshold sweep, single group, the per-policy
+     `auto_groups` picks) under every comm_op lowering the live state
+     permits (`allowed_comm_ops`);
+  2. verify — every candidate is traced abstractly and checked by the jaxpr
+     verifier (`analysis.jaxpr_check`, SCH001..SCH007) BEFORE it may race:
+     the tuner must not be able to commit a schedule that violates the
+     static contract;
+  3. race — each surviving candidate gets warmup + k REAL training steps on
+     the live jitted step (parameters/opt state carried through, so
+     training never pauses or loses a step), timed by
+     `profiling.time_carried_steps`;
+  4. refit — per-group residuals between `solver.predict_group_times` and
+     measured group wall-clock (profiler-trace events where the backend
+     preserves name-stack scopes in op metadata — real TPU — and step-time
+     deltas otherwise, e.g. the CPU mesh) refit alpha/beta/update_beta via
+     `costmodel.refit_from_observations`; the re-solved schedule joins the
+     race;
+  5. commit — the measured argmin is hot-swapped in (the elastic-resize
+     re-solve seam) and persisted in a schedule cache keyed by
+     (model, world size, comm_op, dtype) under profiles/, so subsequent
+     runs skip the search and cold-start on the tuned schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Optional, Sequence
+
+from mgwfbp_tpu.parallel.costmodel import check_schema_version
+from mgwfbp_tpu.parallel.solver import (
+    LayerSpec,
+    effective_cost_fn,
+    schedule_frontier,
+)
+
+# Version stamp of cache entries (same convention as the calibration
+# profiles' schema_version, costmodel.PROFILE_SCHEMA_VERSION — the cache
+# reuses that format family and will evolve it independently).
+CACHE_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One schedule the tuner may race: an explicit grouping + lowering."""
+
+    label: str
+    groups: tuple[tuple[int, ...], ...]
+    comm_op: str
+    predicted_total_s: float = float("nan")
+
+
+@dataclasses.dataclass
+class RaceEntry:
+    """Outcome of one candidate's verification + timed steps."""
+
+    label: str
+    comm_op: str
+    num_groups: int
+    verified: bool = False
+    measured_step_s: Optional[float] = None
+    predicted_total_s: Optional[float] = None
+    groups: tuple[tuple[int, ...], ...] = ()
+
+    def to_json(self) -> dict:
+        return {
+            "label": self.label,
+            "comm_op": self.comm_op,
+            "num_groups": self.num_groups,
+            "verified": self.verified,
+            "measured_step_s": self.measured_step_s,
+            "predicted_total_s": self.predicted_total_s,
+            "groups": [list(g) for g in self.groups],
+        }
+
+
+def allowed_comm_ops(base: str) -> tuple[str, ...]:
+    """Lowerings a candidate may race under, given the configured one.
+
+    all_reduce and rs_ag are freely interchangeable (same replicated state,
+    numerically identical reduction), so candidates race under both. hier is
+    pinned to its two-axis mesh and rs_opt_ag owns the device-sharded
+    optimizer state (a different state layout per schedule is already
+    handled by the hot-swap seam, but a different *optimizer contract*
+    mid-run is not a tuning knob) — those race schedule shapes only.
+    """
+    if base in ("all_reduce", "rs_ag"):
+        return ("all_reduce", "rs_ag")
+    return (base,)
+
+
+def build_candidates(
+    specs: Sequence[LayerSpec],
+    tb: Sequence[float],
+    cost_model,
+    comm_ops: Sequence[str],
+    *,
+    max_candidates: int = 6,
+    incumbent: Optional[tuple[Sequence[Sequence[int]], str]] = None,
+) -> list[Candidate]:
+    """The candidate frontier: solver picks under each permitted lowering.
+
+    Candidates are ranked by predicted total step time and capped at
+    `max_candidates`; the incumbent (the live solved schedule) is always
+    included — the race must be able to conclude "keep what we have".
+    """
+    gamma = float(getattr(cost_model, "gamma", 0.0))
+    overlap = float(getattr(cost_model, "overlap", 1.0))
+    pack_beta = float(getattr(cost_model, "pack_beta", 0.0))
+    sizes = [s.size for s in specs]
+    itemsizes = [s.itemsize for s in specs]
+    out: list[Candidate] = []
+    seen: set[tuple] = set()
+    for op in comm_ops:
+        cost = effective_cost_fn(cost_model, op)
+        for detail, groups, pred in schedule_frontier(
+            sizes, tb, cost_model.alpha, cost, itemsizes, gamma=gamma,
+            overlap=overlap, pack_beta=pack_beta,
+            max_candidates=max(max_candidates, 2),
+        ):
+            key = (op, tuple(map(tuple, groups)))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Candidate(
+                label=f"{op}:{detail}",
+                groups=tuple(tuple(int(i) for i in g) for g in groups),
+                comm_op=op,
+                predicted_total_s=float(pred),
+            ))
+    out.sort(key=lambda c: c.predicted_total_s)
+    kept = out[:max_candidates]
+    # The race can only refit from step-time deltas when the roster spans
+    # MORE THAN ONE group count (autotune.step_delta_observations needs >=2
+    # distinct payload sizes), and a mis-calibrated model loves to rank the
+    # whole frontier onto one shape — keep the best differently-shaped
+    # candidate in the roster even when its prediction ranks it out.
+    if len(kept) >= 2 and len({len(c.groups) for c in kept}) < 2:
+        alt = next(
+            (c for c in out if len(c.groups) != len(kept[0].groups)), None
+        )
+        if alt is not None:
+            kept = kept[:-1] + [alt]
+    out = kept
+    if incumbent is not None:
+        inc_groups = tuple(tuple(int(i) for i in g) for g in incumbent[0])
+        key = (incumbent[1], inc_groups)
+        if key not in {(c.comm_op, c.groups) for c in out}:
+            inc = Candidate(
+                label=f"{incumbent[1]}:incumbent",
+                groups=inc_groups,
+                comm_op=incumbent[1],
+            )
+            if len(out) >= max_candidates and len(out) > 1:
+                # make room WITHOUT collapsing group-count diversity: drop
+                # the worst-predicted entry whose group count another
+                # remaining candidate (or the incumbent) still covers —
+                # never the sole representative of a shape
+                counts = [len(c.groups) for c in out] + [len(inc.groups)]
+                drop = len(out) - 1
+                for i in range(len(out) - 1, -1, -1):
+                    if counts.count(counts[i]) > 1:
+                        drop = i
+                        break
+                out = out[:drop] + out[drop + 1:]
+            out = [inc] + out
+    return out
+
+
+def step_delta_observations(
+    entries: Sequence[RaceEntry], total_bytes: float, tb_total_s: float
+) -> list[tuple[float, float]]:
+    """Pseudo per-collective (bytes, seconds) observations from whole-step
+    timings — the refit's fallback when the profiler trace attributes
+    nothing (no scoped op metadata, e.g. the CPU mesh).
+
+    For a raced schedule of n groups over the model's constant total_bytes,
+    the comm + per-group-overhead share of its measured step is
+    ~(measured - tb_total); split evenly over its n collectives that yields
+    one sample at payload total_bytes/n. Schedules with different group
+    counts then populate the payload axis, and `fit_alpha_beta` recovers a
+    per-collective fixed cost (alpha + gamma) and a per-byte rate. Coarse
+    by construction — it assumes the serialized timeline (overlap ~ 0,
+    the CPU-mesh regime); on platforms that hide comm well the trace path
+    should win.
+    """
+    obs: list[tuple[float, float]] = []
+    for e in entries:
+        if e.measured_step_s is None or e.num_groups <= 0:
+            continue
+        comm = e.measured_step_s - tb_total_s
+        if comm <= 0.0:
+            continue
+        obs.append((total_bytes / e.num_groups, comm / e.num_groups))
+    if len({round(b) for b, _ in obs}) < 2:
+        return []  # fit needs >= 2 distinct payload sizes
+    return obs
+
+
+def model_summary(model) -> dict:
+    """The scalar cost-model fields a refit can move (cache provenance)."""
+    return {
+        "alpha": float(getattr(model, "alpha", 0.0)),
+        "beta": float(getattr(model, "beta", 0.0)),
+        "gamma": float(getattr(model, "gamma", 0.0)),
+        "overlap": float(getattr(model, "overlap", 1.0)),
+        "pack_beta": float(getattr(model, "pack_beta", 0.0)),
+        "update_beta": float(getattr(model, "update_beta", 0.0)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Schedule cache: committed winners, keyed by (model, world, comm_op, dtype).
+# ---------------------------------------------------------------------------
+
+
+def _safe(token) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "-", str(token))
+
+
+def cache_key(
+    model: str,
+    world: int,
+    comm_op: str,
+    dtype,
+    comm_dtype=None,
+    compressor: Optional[str] = None,
+    density: Optional[float] = None,
+    batch_size: Optional[int] = None,
+    nsteps_update: Optional[int] = None,
+) -> str:
+    """Filename-safe cache key. The keyed fields are exactly the ones a
+    schedule is NOT portable across: the layer set rides inside the entry
+    (validated on load), the world size changes the cost constants, the
+    lowering changes the collective contract, the dtypes / compressor
+    change the wire bytes the race optimized for — a winner tuned at bf16
+    wire or 1% density must not be served to an f32 dense run — and the
+    per-device batch (plus accumulation depth) scales tb, which moves the
+    compute/comm balance the grouping was tuned for."""
+    key = f"{_safe(model)}_w{int(world)}_{_safe(comm_op)}_{_safe(dtype)}"
+    if batch_size is not None:
+        key += f"_b{int(batch_size)}"
+    if nsteps_update is not None and int(nsteps_update) > 1:
+        key += f"_acc{int(nsteps_update)}"
+    if comm_dtype is not None:
+        key += f"_wire-{_safe(comm_dtype)}"
+    if compressor not in (None, "", "none"):
+        key += f"_{_safe(compressor)}-{_safe(density)}"
+    return key
+
+
+def entry_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, key + ".json")
+
+
+def load_cache_entry(path: str) -> Optional[dict]:
+    """Committed cache entry at `path`, or None when absent. Rejects
+    unknown schema versions with a clear error instead of silently racing
+    a stale format into the live job."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        d = json.load(f)
+    check_schema_version(
+        d, path=path, supported=(CACHE_SCHEMA_VERSION,),
+        what="schedule-cache entry",
+    )
+    return d
+
+
+def save_cache_entry(path: str, entry: dict) -> None:
+    """Persist a committed schedule (atomic replace: a crashed run must not
+    leave a truncated entry a later run would fail to parse)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    doc = dict(entry)
+    doc["schema_version"] = CACHE_SCHEMA_VERSION
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)

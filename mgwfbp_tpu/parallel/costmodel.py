@@ -147,6 +147,52 @@ def predict_allreduce_time(alpha: float, beta: float, nbytes: float) -> float:
     return alpha + beta * nbytes
 
 
+def refit_from_observations(
+    model,
+    observations: Sequence[tuple[float, float]],
+    comm_op: str = "all_reduce",
+) -> AlphaBeta:
+    """Refit alpha/beta (and update_beta on the rs_opt_ag lowering) from
+    measured per-collective (bucket_bytes, seconds) observations — the
+    autotuner's cost-model correction (`parallel.autotune`).
+
+    The observations are whatever the live job measured for its merge-group
+    collectives (profiler-trace group times, or the step-delta pseudo
+    observations `autotune.step_delta_observations` derives), so the fitted
+    line is the EFFECTIVE per-collective cost. `model`'s gamma is charged
+    separately by the solver's simulation, so it is subtracted from the
+    fitted intercept (floored at 0) to avoid double-counting; on rs_opt_ag
+    the fitted per-byte rate covers beta + update_beta jointly (the shard
+    update rides the same serial timeline), so the rate is split between
+    them in the old model's proportions — the observations cannot separate
+    wire from update, only rescale their sum. gamma/overlap/pack_beta carry
+    over unchanged: they are fit by dedicated microbenches (profiling), not
+    by these residuals.
+    """
+    obs = [(float(b), float(t)) for b, t in observations]
+    if len(obs) < 2:
+        raise ValueError("need at least two (bytes, seconds) observations")
+    ab = fit_alpha_beta([b for b, _ in obs], [t for _, t in obs])
+    gamma = float(getattr(model, "gamma", 0.0))
+    alpha = max(ab.alpha - gamma, 0.0)
+    rate = ab.beta
+    beta = rate
+    update_beta = float(getattr(model, "update_beta", 0.0))
+    if comm_op == "rs_opt_ag" and update_beta > 0.0:
+        old_beta = float(getattr(model, "beta", 0.0))
+        share = update_beta / max(old_beta + update_beta, 1e-30)
+        update_beta = rate * share
+        beta = rate - update_beta
+    return AlphaBeta(
+        alpha=alpha,
+        beta=beta,
+        gamma=gamma,
+        overlap=float(getattr(model, "overlap", 1.0)),
+        pack_beta=float(getattr(model, "pack_beta", 0.0)),
+        update_beta=update_beta,
+    )
+
+
 def fit_alpha_beta(sizes_bytes: Sequence[float], times_s: Sequence[float]) -> AlphaBeta:
     """Closed-form least-squares fit of t = alpha + beta*size.
 
@@ -535,6 +581,40 @@ class TwoLevelAlphaBeta:
         return self.ici.update_beta
 
 
+# ---------------------------------------------------------------------------
+# Profile (de)serialization. Every stamped file carries `schema_version`:
+#   1 — the pre-stamp legacy layout (no version field); identical field set,
+#       migrated on load by assuming the v2 field defaults;
+#   2 — current: v1 plus the explicit stamp.
+# Unknown versions are REJECTED with a clear error instead of half-parsing:
+# the autotuner's schedule cache reuses this convention (autotune.py) and
+# both formats will evolve.
+# ---------------------------------------------------------------------------
+
+PROFILE_SCHEMA_VERSION = 2
+_SUPPORTED_PROFILE_SCHEMAS = (1, 2)
+
+
+def check_schema_version(
+    d: dict,
+    path: str = "<profile>",
+    supported: Sequence[int] = _SUPPORTED_PROFILE_SCHEMAS,
+    what: str = "profile",
+) -> int:
+    """Validate a JSON document's schema_version (absent = 1, the legacy
+    pre-stamp layout). Raises ValueError on anything this build does not
+    know how to read — a newer writer's file must fail loudly, not load as
+    garbage constants that silently skew every schedule solve."""
+    v = d.get("schema_version", 1)
+    if isinstance(v, bool) or not isinstance(v, int) or v not in tuple(supported):
+        raise ValueError(
+            f"{path}: unsupported {what} schema_version {v!r}; this build "
+            f"reads versions {tuple(supported)} — regenerate the file or "
+            "upgrade mgwfbp_tpu"
+        )
+    return v
+
+
 def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
     if isinstance(model, SampledCost):
         return {
@@ -571,49 +651,34 @@ def save_profile(
     meta: Optional[dict] = None,
 ) -> None:
     """Persist a calibrated model; `meta` (device kind, mesh, date) is
-    carried for provenance and ignored on load."""
+    carried for provenance and ignored on load. The file is stamped with
+    `schema_version` (PROFILE_SCHEMA_VERSION); loads reject versions this
+    build does not know."""
+    if isinstance(model, ProfileFamily):
+        doc = {
+            "kind": "family",
+            "entries": {
+                str(k): _model_dict(v)
+                for k, v in sorted(model.entries.items())
+            },
+        }
+    elif isinstance(model, SampledCost):
+        doc = _model_dict(model)
+    elif isinstance(model, TwoLevelAlphaBeta):
+        doc = {
+            "kind": "two_level",
+            "ici": dataclasses.asdict(model.ici),
+            "dcn": dataclasses.asdict(model.dcn),
+            "ici_size": model.ici_size,
+            "dcn_size": model.dcn_size,
+        }
+    else:
+        doc = {"kind": "flat", **dataclasses.asdict(model)}
+    doc["schema_version"] = PROFILE_SCHEMA_VERSION
+    if meta:
+        doc["meta"] = meta
     with open(path, "w") as f:
-        if isinstance(model, ProfileFamily):
-            json.dump(
-                {
-                    "kind": "family",
-                    "entries": {
-                        str(k): _model_dict(v)
-                        for k, v in sorted(model.entries.items())
-                    },
-                    **({"meta": meta} if meta else {}),
-                },
-                f,
-            )
-        elif isinstance(model, SampledCost):
-            json.dump(
-                {
-                    **_model_dict(model),
-                    **({"meta": meta} if meta else {}),
-                },
-                f,
-            )
-        elif isinstance(model, TwoLevelAlphaBeta):
-            json.dump(
-                {
-                    "kind": "two_level",
-                    "ici": dataclasses.asdict(model.ici),
-                    "dcn": dataclasses.asdict(model.dcn),
-                    "ici_size": model.ici_size,
-                    "dcn_size": model.dcn_size,
-                    **({"meta": meta} if meta else {}),
-                },
-                f,
-            )
-        else:
-            json.dump(
-                {
-                    "kind": "flat",
-                    **dataclasses.asdict(model),
-                    **({"meta": meta} if meta else {}),
-                },
-                f,
-            )
+        json.dump(doc, f)
 
 
 def load_profile(
@@ -625,6 +690,8 @@ def load_profile(
     nworkers)` / `ProfileFamily.at`)."""
     with open(path) as f:
         d = json.load(f)
+    check_schema_version(d, path=path)
+    d.pop("schema_version", None)  # v1 (unstamped) migrates transparently
     kind = d.get("kind", "flat")
     d.pop("meta", None)
     if kind == "two_level":

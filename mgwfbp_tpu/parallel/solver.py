@@ -338,6 +338,44 @@ def auto_groups(
         return [], "empty"
     itemsizes = [itemsize] * L if isinstance(itemsize, int) else list(itemsize)
     nbytes = [int(s) * it for s, it in zip(sizes, itemsizes)]
+    candidates = candidate_groupings(
+        sizes, tb, alpha, cost, itemsizes, gamma=gamma, pack_beta=pack_beta
+    )
+    best = None
+    for detail, groups in candidates:
+        total, _, _ = simulate_groups(
+            groups, nbytes, tb, cost, gamma, overlap, pack_beta
+        )
+        if best is None or total < best[0]:
+            best = (total, groups, detail)
+    return best[1], best[2]
+
+
+def candidate_groupings(
+    sizes: Sequence[int],
+    tb: Sequence[float],
+    alpha: float,
+    cost: CostFn,
+    itemsize: int | Sequence[int] = 4,
+    gamma: float = 0.0,
+    pack_beta: float = 0.0,
+) -> list[tuple[str, list[list[int]]]]:
+    """Enumerate the solver's candidate schedules, deduped by group shape.
+
+    The shared candidate set behind `auto_groups` (simulate-and-argmin) and
+    `schedule_frontier` (the autotuner's race roster): the per-policy picks
+    (wfbp / single / the mgwfbp scan), a geometric merge-threshold sweep,
+    and — when bucketization has a per-byte price — the isolate-the-bigs
+    shapes. Dedup is by group SHAPE, not count: two thresholds can produce
+    the same number of groups with different boundaries (e.g. sizes
+    [5,5,5,5] at th=6 vs th=11), and those are distinct schedules a
+    consumer must see.
+    """
+    L = len(sizes)
+    if L == 0:
+        return []
+    itemsizes = [itemsize] * L if isinstance(itemsize, int) else list(itemsize)
+    nbytes = [int(s) * it for s, it in zip(sizes, itemsizes)]
     candidates: list[tuple[str, list[list[int]]]] = [
         ("wfbp", threshold_groups(sizes, 0)),
         ("single", single_group(sizes)),
@@ -345,9 +383,6 @@ def auto_groups(
     ]
     total_elems = int(sum(sizes))
     th = 1 << 14
-    # dedup by group SHAPE, not count — two thresholds can produce the same
-    # number of groups with different boundaries (e.g. sizes [5,5,5,5] at
-    # th=6 vs th=11), and those are distinct schedules the argmin must see
     seen_shapes = {tuple(map(tuple, g)) for _, g in candidates}
     while th < total_elems:
         groups = threshold_groups(sizes, th)
@@ -368,14 +403,76 @@ def auto_groups(
                 seen_shapes.add(key)
                 candidates.append((f"isolate-bigs:{bb}", groups))
             bb <<= 1
-    best = None
-    for detail, groups in candidates:
+    return candidates
+
+
+def schedule_frontier(
+    sizes: Sequence[int],
+    tb: Sequence[float],
+    alpha: float,
+    cost: CostFn,
+    itemsize: int | Sequence[int] = 4,
+    *,
+    gamma: float = 0.0,
+    overlap: float = 1.0,
+    pack_beta: float = 0.0,
+    max_candidates: int = 6,
+) -> list[tuple[str, list[list[int]], float]]:
+    """The argmin's neighbourhood: candidate schedules ranked by predicted
+    total step time, for the in-situ autotuner to RACE on the live job
+    (`parallel.autotune`).
+
+    Returns up to `max_candidates` (detail, groups, predicted_total_s)
+    tuples, cheapest predicted first. The single-group schedule is always
+    kept in the roster even when its prediction ranks it out: under a
+    mis-calibrated cost model the prediction order is exactly what cannot
+    be trusted, and `single` is the structural extreme the prediction most
+    often mis-ranks (VERDICT r3 Weak #1: single beat mgwfbp on 2 of 3
+    measured grids while the model said otherwise).
+    """
+    L = len(sizes)
+    if L == 0:
+        return []
+    itemsizes = [itemsize] * L if isinstance(itemsize, int) else list(itemsize)
+    nbytes = [int(s) * it for s, it in zip(sizes, itemsizes)]
+    scored: list[tuple[str, list[list[int]], float]] = []
+    for detail, groups in candidate_groupings(
+        sizes, tb, alpha, cost, itemsizes, gamma=gamma, pack_beta=pack_beta
+    ):
         total, _, _ = simulate_groups(
             groups, nbytes, tb, cost, gamma, overlap, pack_beta
         )
-        if best is None or total < best[0]:
-            best = (total, groups, detail)
-    return best[1], best[2]
+        scored.append((detail, groups, float(total)))
+    scored.sort(key=lambda c: c[2])
+    out = scored[: max(max_candidates, 1)]
+    if not any(len(g) == 1 and len(g[0]) == L for _, g, _ in out):
+        fallback = next(
+            (c for c in scored if len(c[1]) == 1 and len(c[1][0]) == L), None
+        )
+        if fallback is not None:
+            out = out[:-1] + [fallback] if len(out) >= max_candidates else (
+                out + [fallback]
+            )
+    return out
+
+
+def size_prior_tb(
+    layers: Sequence["LayerSpec"], cost_model=None
+) -> list[float]:
+    """Fallback tb when no measured backward profile exists: SHAPE from
+    parameter volume, SCALE from the cost model — total backward time taken
+    as the predicted time to all-reduce the whole model once (the regime
+    where merging decisions matter; if compute is far cheaper than comm the
+    solver converges to one group, if far more expensive to per-layer
+    groups — both safe). Shared by `make_merged_allreduce` and the
+    autotuner so the two can never disagree on the prior."""
+    total_size = float(sum(l.size for l in layers)) or 1.0
+    total_bytes = float(sum(l.nbytes for l in layers))
+    if cost_model is not None:
+        tb_total = float(cost_model.predict(total_bytes))
+    else:
+        tb_total = 1e-3  # last-resort scale, no information available
+    return [tb_total * l.size / total_size for l in layers]
 
 
 def build_schedule(
@@ -386,6 +483,8 @@ def build_schedule(
     cost_model: AlphaBeta | TwoLevelAlphaBeta | None = None,
     threshold: int = 0,
     comm_op: str = "all_reduce",
+    groups: Optional[Sequence[Sequence[int]]] = None,
+    policy_detail: Optional[str] = None,
 ) -> MergeSchedule:
     """Build a MergeSchedule for gradient tensors in arrival order.
 
@@ -398,6 +497,12 @@ def build_schedule(
     comm_op: the lowering the schedule will be issued as; 'rs_opt_ag' adds
     the update-in-the-middle term to every per-bucket cost prediction
     (`effective_cost_fn`) so the schedule still describes the wire.
+
+    groups: an EXPLICIT grouping (arrival-order index groups) that bypasses
+    the policy solve — the autotuner's raced candidates and cache hits
+    enter here. Must cover every layer index exactly once; predictions are
+    still simulated under the cost model so the schedule stays comparable
+    to solved ones. `policy_detail` labels its provenance.
     """
     sizes = [l.size for l in layers]
     names = tuple(l.name for l in layers)
@@ -412,7 +517,16 @@ def build_schedule(
     )
 
     detail = ""
-    if policy == "mgwfbp":
+    if groups is not None:
+        fixed = [list(int(i) for i in g) for g in groups]
+        if sorted(i for g in fixed for i in g) != list(range(len(layers))):
+            raise ValueError(
+                "explicit groups must cover every layer index exactly once "
+                f"(got {len(layers)} layers, groups {fixed})"
+            )
+        groups = fixed
+        detail = policy_detail or "fixed"
+    elif policy == "mgwfbp":
         if tb is None or cost_model is None:
             raise ValueError("policy 'mgwfbp' requires tb and cost_model")
         groups = mgwfbp_groups(

@@ -311,6 +311,51 @@ def measure_step_time(
     return (time.perf_counter() - t0) / iters
 
 
+def time_carried_steps(
+    step_once: Callable[[Any], Any],
+    state: Any,
+    iters: int,
+    warmup: int = 1,
+) -> tuple[Any, float]:
+    """`measure_step_time` for LIVE training: time real steps while
+    CARRYING the train state through, so every timed call is a genuine
+    optimizer step on a fresh batch and nothing is discarded or replayed
+    (the autotuner's race protocol — training never pauses or loses steps;
+    `measure_step_time` re-feeds the same args, which donated-buffer steps
+    cannot even accept twice).
+
+    step_once(state) -> new_state must consume its own fresh batch per
+    call. warmup steps (the first call compiles) run un-timed; the timed
+    window is bracketed by one end sync like the bench protocol. Returns
+    (final_state, sec_per_step).
+    """
+    for _ in range(max(warmup, 0)):
+        state = step_once(state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    n = max(iters, 1)
+    for _ in range(n):
+        state = step_once(state)
+    jax.block_until_ready(state)
+    return state, (time.perf_counter() - t0) / n
+
+
+class TbProfile(list):
+    """Arrival-ordered per-layer backward seconds, plus provenance.
+
+    `source` records which path produced the numbers: 'trace' (profiler-
+    event attribution, truly measured per layer) or 'volume-prior' (the
+    measured TOTAL split by analytic numel weights — measured scale,
+    approximate shape). A plain list everywhere it is consumed; the tag
+    rides along for logs, the persisted tb_profile.json, and the autotune
+    cache, so a schedule can always be audited back to how its tb was
+    obtained."""
+
+    def __init__(self, values, source: str = "volume-prior"):
+        super().__init__(float(v) for v in values)
+        self.source = source
+
+
 def benchmark_backward(
     loss_fn: Callable,
     params: Any,
@@ -318,18 +363,32 @@ def benchmark_backward(
     perm: Sequence[int],
     warmup: int = 5,
     iters: int = 50,
-) -> list[float]:
-    """Layer-wise backward durations tb (arrival order): measured total
-    backward wall-clock distributed by analytic weights.
+    names: Optional[Sequence[str]] = None,
+) -> "TbProfile":
+    """Layer-wise backward durations tb (arrival order).
 
     loss_fn(params, *loss_args) -> scalar. The returned list feeds
     `solver.build_schedule` exactly like the reference's measured
     `layerwise_times` (dist_trainer.py:45-51).
+
+    With `names` (leaf key paths), the per-layer times are MEASURED by
+    profiler-trace attribution (`trace_layerwise_backward`) scaled to the
+    measured wall-clock total; the analytic numel-weight split of the
+    measured total remains the documented fallback when no trace events
+    attribute (exotic backends, or names not given). The result's
+    `.source` tag records which path produced the numbers.
     """
     grad_fn = jax.jit(jax.grad(lambda p: loss_fn(p, *loss_args)))
     total = measure_step_time(grad_fn, params, warmup=warmup, iters=iters)
+    if names is not None:
+        tb = trace_layerwise_backward(
+            grad_fn, params, names, perm, iters=min(max(iters, 1), 5),
+            total_s=total,
+        )
+        if tb is not None:
+            return TbProfile(tb, source="trace")
     weights = backward_cost_weights(params, perm)
-    return [float(total * w) for w in weights]
+    return TbProfile((total * w for w in weights), source="volume-prior")
 
 
 def _leaf_scopes(names: Sequence[str]) -> list[str]:
@@ -369,6 +428,29 @@ def _trace_events(logdir: str) -> list[tuple[str, float]]:
     return rows
 
 
+def _with_trace_events(
+    run: Callable[[], None],
+    logdir: Optional[str] = None,
+    prefix: str = "mgwfbp_trace_",
+) -> list[tuple[str, float]]:
+    """Run `run()` under `jax.profiler.trace` and return the collected
+    (identifier, duration_us) rows. Owns (and removes) a temporary logdir
+    when none is given — the shared scaffolding of every trace-attribution
+    path (`trace_layerwise_backward`, `trace_group_times`)."""
+    import shutil
+    import tempfile
+
+    own = logdir is None
+    logdir = logdir or tempfile.mkdtemp(prefix=prefix)
+    try:
+        with jax.profiler.trace(logdir):
+            run()
+        return _trace_events(logdir)
+    finally:
+        if own:
+            shutil.rmtree(logdir, ignore_errors=True)
+
+
 def trace_layerwise_backward(
     grad_fn: Callable,
     params: Any,
@@ -399,26 +481,19 @@ def trace_layerwise_backward(
     forward carries that module's name-stack scope in its metadata, and the
     backward ops carry the same scope under `transpose(jvp(...))`.
     """
-    import shutil
-    import tempfile
-
-    own = logdir is None
-    logdir = logdir or tempfile.mkdtemp(prefix="mgwfbp_tb_trace_")
     total = (
         total_s
         if total_s is not None
         else measure_step_time(grad_fn, params, warmup=0, iters=iters)
     )
-    try:
-        with jax.profiler.trace(logdir):
-            out = None
-            for _ in range(iters):
-                out = grad_fn(params)
-            jax.block_until_ready(out)
-        rows = _trace_events(logdir)
-    finally:
-        if own:
-            shutil.rmtree(logdir, ignore_errors=True)
+
+    def run():
+        out = None
+        for _ in range(iters):
+            out = grad_fn(params)
+        jax.block_until_ready(out)
+
+    rows = _with_trace_events(run, logdir, prefix="mgwfbp_tb_trace_")
     if not rows:
         return None
     scopes = _leaf_scopes(names)
@@ -518,7 +593,117 @@ def benchmark_trainer_backward(
             run, params, names, perm, iters=iters, total_s=total
         )
         if tb is not None:
-            return tb
+            return TbProfile(tb, source="trace")
     return benchmark_backward(
         scalar_loss, params, (example_batch,), perm, warmup=warmup, iters=iters
     )
+
+
+def trace_group_times(
+    run_steps: Callable[[], None],
+    num_groups: int,
+    iters: int = 1,
+    logdir: Optional[str] = None,
+) -> Optional[list[float]]:
+    """Measured per-merge-group wall-clock from a profiler trace.
+
+    run_steps() must execute `iters` live training steps (carrying state)
+    and block until done; every device op a merge group issues carries its
+    `mgwfbp_groupNNNN` name scope in the op metadata (the same introspection
+    hook the jaxpr verifier matches on), so each group's time is the sum of
+    its scoped event durations, averaged over the traced steps.
+
+    Returns arrival-order seconds per group per step, or None when the
+    trace attributes nothing for some group — backends that drop the name
+    stack from op metadata (the virtual CPU mesh) land here, and the
+    autotuner falls back to step-time deltas
+    (`autotune.step_delta_observations`).
+    """
+    rows = _with_trace_events(
+        run_steps, logdir, prefix="mgwfbp_group_trace_"
+    )
+    if not rows:
+        return None
+    from mgwfbp_tpu.parallel.allreduce import group_scope_name
+
+    out: list[float] = []
+    for gi in range(num_groups):
+        tag = group_scope_name(gi)
+        dur_us = sum(dur for ident, dur in rows if tag in ident)
+        if dur_us <= 0.0:
+            return None  # partial attribution is worse than none
+        out.append(dur_us * 1e-6 / max(iters, 1))
+    return out
+
+
+def profile_update_beta(
+    mesh: Mesh,
+    total_elems: int = 1 << 22,
+    warmup: int = 3,
+    iters: int = 10,
+    axis_name: str = DATA_AXIS,
+    dtype=jnp.float32,
+) -> float:
+    """Measure update_beta: the per-BUCKET-byte cost of the fused shard
+    optimizer update the rs_opt_ag lowering runs between the reduce-scatter
+    and the param all-gather (costmodel.AlphaBeta.update_beta).
+
+    Two single-group programs of identical payload and collective phases —
+    the plain rs_ag reduction vs rs_opt_ag with an SGD-momentum shard
+    update in the middle — isolate the update's link-timeline occupancy;
+    the difference divided by the BUCKET bytes is update_beta. The 1/world
+    factor is folded in automatically: the measured update touches only the
+    1/world shard while the divisor is the full bucket, exactly the
+    convention the solver's `effective_cost_fn` charges.
+    """
+    from mgwfbp_tpu.optim import OptimSpec
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+
+    world = mesh.shape[axis_name]
+    leaves = [jnp.ones((total_elems,), dtype)]
+    names = ["g0000"]
+
+    def timed(fn, *args) -> float:
+        for _ in range(warmup):
+            jax.block_until_ready(fn(*args))
+        best = float("inf")
+        for _ in range(3):  # min-of-3 windows, like profile_group_overhead
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    rs = make_merged_allreduce(
+        leaves, axis_name=axis_name, policy="single", names=names,
+        comm_op="rs_ag",
+    )
+    fn_rs = jax.jit(
+        shard_map(
+            lambda t: rs(t), mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    t_rs = timed(fn_rs, leaves)
+
+    spec = OptimSpec(lr=1e-3, kind="sgd", momentum=0.9)
+    opt_red = make_merged_allreduce(
+        leaves, axis_name=axis_name, policy="single", names=names,
+        comm_op="rs_opt_ag", optim_spec=spec, world_size=world,
+    )
+    opt_state = opt_red.optim.init()
+    state_spec = opt_red.optim.partition_spec()
+    fn_opt = jax.jit(
+        shard_map(
+            lambda g, p, o: opt_red.reduce_and_update(g, p, o),
+            mesh=mesh,
+            in_specs=(P(), P(), state_spec),
+            out_specs=(P(), state_spec),
+            check_vma=False,
+        )
+    )
+    t_opt = timed(fn_opt, leaves, leaves, opt_state)
+    nbytes = float(total_elems * jnp.dtype(dtype).itemsize)
+    return max((t_opt - t_rs) / nbytes, 0.0)

@@ -184,6 +184,7 @@ class Trainer:
         self.start_epoch = 0
         self.iteration = 0
         self.carry = None
+        self.autotune_report = None  # set by autotune() (cache hit or race)
         self._maybe_resume()
 
     # ------------------------------------------------------------------
@@ -433,6 +434,582 @@ class Trainer:
             else f", merge schedule re-solved: {self.reducer.schedule.num_groups} groups",
         )
 
+    # ------------------------------------------------------------------
+    # Closed-loop schedule autotuning (ISSUE 3). parallel/autotune.py owns
+    # the pure parts (frontier, cache, step-delta observations); these
+    # methods own the live pieces — the jitted step, the train state, the
+    # data stream, and the hot-swap through the elastic-resize seam.
+    # ------------------------------------------------------------------
+
+    def autotune(self, steps_per_candidate: Optional[int] = None):
+        """Close the solver's loop on the live job.
+
+        Races verified candidate schedules for warmup + k REAL training
+        steps each (state carried through — no step is paused or lost),
+        refits the cost model from the measurements, re-solves once, and
+        commits the measured argmin, persisting it in the schedule cache
+        keyed by (model, world, comm_op, dtype). A later run with the same
+        key skips the race and cold-starts on the committed schedule.
+
+        Returns the report dict (also kept as self.autotune_report), or
+        None when there is nothing to tune (no merged reducer).
+        """
+        import itertools
+
+        from mgwfbp_tpu.parallel import autotune as at
+        from mgwfbp_tpu.parallel.costmodel import refit_from_observations
+        from mgwfbp_tpu.parallel.solver import (
+            LayerSpec, build_schedule, size_prior_tb,
+        )
+
+        cfg = self.config
+        if self.reducer is None:
+            self.log.info(
+                "autotune: nothing to tune (no merged reducer: policy %r "
+                "or single device)", cfg.policy,
+            )
+            return None
+        if jax.process_count() > 1:
+            # every process would time candidates with its own wall clock
+            # and refit its own model; two hosts committing different
+            # schedules issue mismatched collectives -> distributed hang.
+            # The race needs a broadcast-agreed argmin (like tb in
+            # _profile_backward) — ROADMAP follow-up; refuse until then.
+            self.log.warning(
+                "autotune: skipped on multi-host runs (per-process timings "
+                "could commit divergent schedules); tune single-host and "
+                "ship the cache entry instead"
+            )
+            return None
+        world = self.data_size * self.seq_size
+        cache_dir = cfg.schedule_cache or os.path.join(
+            "profiles", "schedule_cache"
+        )
+        key = at.cache_key(
+            cfg.dnn, world, cfg.comm_op, cfg.dtype,
+            comm_dtype=cfg.comm_dtype,
+            compressor=cfg.compressor, density=cfg.density,
+            batch_size=cfg.batch_size, nsteps_update=cfg.nsteps_update,
+        )
+        path = at.entry_path(cache_dir, key)
+        entry = at.load_cache_entry(path)
+        names_now = list(self.reducer.schedule.layer_names)
+        if entry is not None and entry.get("layer_names") == names_now:
+            groups = tuple(tuple(int(i) for i in g) for g in entry["groups"])
+            if not self._reducer_is_live(groups, entry["comm_op"]):
+                self._swap_reducer(self._reducer_for(
+                    groups, entry["comm_op"],
+                    detail=f"autotune-cache:{entry.get('winner', 'winner')}",
+                ))
+            self.log.info(
+                "autotune: cache hit %s — committed schedule loaded "
+                "(%d groups, comm_op=%s), race skipped",
+                path, len(groups), entry["comm_op"],
+            )
+            self.autotune_report = {
+                "source": "cache", "cache_path": path,
+                "comm_op": entry["comm_op"],
+                "groups": [list(g) for g in groups],
+                "winner": entry.get("winner"),
+            }
+            return self.autotune_report
+        if entry is not None:
+            self.log.warning(
+                "autotune: cache entry %s was tuned for a different "
+                "parameter set; re-tuning", path,
+            )
+
+        # ---- frontier ------------------------------------------------
+        leaves = jax.tree_util.tree_leaves(self.state.params)
+        arr = [leaves[j] for j in self.reducer.perm]
+        specs = [
+            LayerSpec(
+                name=nm,
+                size=int(np.prod(l.shape)) if l.shape else 1,
+                itemsize=jnp.dtype(l.dtype).itemsize,
+            )
+            for nm, l in zip(names_now, arr)
+        ]
+        cost_model = getattr(self, "cost_model", None)
+        tb = (
+            list(self._tb_cache)
+            if self._tb_cache is not None
+            else size_prior_tb(specs, cost_model)
+        )
+        # "both comm_op lowerings where state permits": a sparsifying
+        # compressor replaces the bucket collective, so only the configured
+        # all_reduce path is raceable under it
+        comm_ops = (
+            ("all_reduce",)
+            if self._compressor is not None
+            else at.allowed_comm_ops(cfg.comm_op)
+        )
+        candidates = at.build_candidates(
+            specs, tb, cost_model, comm_ops,
+            max_candidates=max(int(cfg.autotune_candidates), 1),
+            incumbent=(self.reducer.schedule.groups, cfg.comm_op),
+        )
+        steps = int(
+            steps_per_candidate
+            if steps_per_candidate is not None
+            else cfg.autotune_steps
+        )
+        steps = max(steps, 1)
+        self.log.info(
+            "autotune: racing %d candidate(s), %d timed step(s) each "
+            "(cache key %s)", len(candidates), steps, key,
+        )
+
+        original = self.reducer
+        batch_iter = self._autotune_batches()
+        sample_batch = next(batch_iter)
+        batch_iter = itertools.chain([sample_batch], batch_iter)
+        # burn-in on the incumbent: the process's first real steps carry
+        # one-off host-side warmup (loader pipeline, dispatch pools) that
+        # would bias whichever candidate happens to race first; these are
+        # still genuine training steps — nothing is discarded
+        for _ in range(2):
+            self.state = self._apply_train_step(self.state, next(batch_iter))
+        jax.block_until_ready(self.state)
+        self.iteration += 2
+        self._train_step_compiled = True
+        entries = []
+        raced_shapes: set = set()
+        for c in candidates:
+            e = self._race_candidate(c, batch_iter, sample_batch, steps)
+            entries.append(e)
+            # record BOTH the requested shape and the issued (post-layout)
+            # shape: the refit re-solve emits pre-layout groups, and on
+            # dtype-mixed models the two differ — deduping on only one
+            # side would re-race an already-timed schedule
+            raced_shapes.add((c.comm_op, tuple(map(tuple, c.groups))))
+            raced_shapes.add((e.comm_op, tuple(map(tuple, e.groups))))
+
+        # ---- refit from observations + one re-solve ------------------
+        refit_info = None
+        measured_groups = None
+        timed = [e for e in entries if e.measured_step_s is not None]
+        if timed and cost_model is not None:
+            best = min(timed, key=lambda e: e.measured_step_s)
+            if not self._reducer_is_live(best.groups, best.comm_op):
+                self._swap_reducer(self._reducer_for(
+                    best.groups, best.comm_op,
+                    detail=f"autotune:{best.label}",
+                ))
+            total_bytes = float(sum(s.nbytes for s in specs))
+            obs, obs_source, measured_groups = self._group_observations(
+                batch_iter, entries, total_bytes, float(sum(tb))
+            )
+            # the trace timed THIS schedule; remember whose groups the
+            # per-group seconds belong to (the refit candidate may win
+            # with a different grouping, and the cache must not pair its
+            # groups with another schedule's measurements)
+            traced_schedule = (
+                self.reducer.comm_op,
+                tuple(map(tuple, self.reducer.layout.groups)),
+            )
+            if len(obs) >= 2:
+                try:
+                    new_model = refit_from_observations(
+                        cost_model, obs, cfg.comm_op
+                    )
+                except ValueError as e:
+                    self.log.info("autotune: refit skipped (%s)", e)
+                else:
+                    refit_info = {
+                        "before": at.model_summary(cost_model),
+                        "after": at.model_summary(new_model),
+                        "source": obs_source,
+                        "observations": [
+                            [float(b), float(t)] for b, t in obs
+                        ],
+                    }
+                    self.cost_model = new_model
+                    resolved = build_schedule(
+                        specs, tb, policy="auto", cost_model=new_model,
+                        comm_op=cfg.comm_op,
+                    )
+                    shape = tuple(tuple(g) for g in resolved.groups)
+                    if (cfg.comm_op, shape) not in raced_shapes:
+                        cand = at.Candidate(
+                            label=(
+                                f"{cfg.comm_op}:refit->"
+                                f"{resolved.policy_detail or 'auto'}"
+                            ),
+                            groups=shape,
+                            comm_op=cfg.comm_op,
+                            predicted_total_s=float(
+                                resolved.predicted_total_time
+                            ),
+                        )
+                        entries.append(self._race_candidate(
+                            cand, batch_iter, sample_batch, steps
+                        ))
+                    timed = [
+                        e for e in entries if e.measured_step_s is not None
+                    ]
+
+        # ---- commit the measured argmin + persist --------------------
+        if not timed:
+            self.log.warning(
+                "autotune: no candidate survived verification/racing; "
+                "keeping the solved schedule"
+            )
+            if self.reducer is not original:
+                self._swap_reducer(original)
+            self.autotune_report = {
+                "source": "race", "cache_path": None,
+                "race": [e.to_json() for e in entries],
+            }
+            return self.autotune_report
+        winner = min(timed, key=lambda e: e.measured_step_s)
+        if measured_groups is not None and traced_schedule != (
+            winner.comm_op, tuple(map(tuple, winner.groups))
+        ):
+            measured_groups = None  # traced a different schedule's groups
+        if not self._reducer_is_live(winner.groups, winner.comm_op):
+            self._swap_reducer(self._reducer_for(
+                winner.groups, winner.comm_op,
+                detail=f"autotune:{winner.label}",
+            ))
+        cache_entry = {
+            "key": key,
+            "model": cfg.dnn,
+            "world": world,
+            "comm_op": winner.comm_op,
+            "dtype": cfg.dtype,
+            "layer_names": names_now,
+            "winner": winner.label,
+            "groups": [list(g) for g in winner.groups],
+            "measured_step_s": winner.measured_step_s,
+            "tb_source": (
+                getattr(self._tb_cache, "source", "volume-prior")
+                if self._tb_cache is not None
+                else "size-prior"
+            ),
+            "race": [e.to_json() for e in entries],
+            "refit": refit_info,
+            "solved_group_times": [
+                [int(b), float(t)]
+                for b, t in self.reducer.schedule.predicted_group_times
+            ],
+            "measured_group_times": measured_groups,
+        }
+        at.save_cache_entry(path, cache_entry)
+        self.log.info(
+            "autotune: committed %s (%d groups, comm_op=%s, %.4g s/step) "
+            "-> %s", winner.label, len(winner.groups), winner.comm_op,
+            winner.measured_step_s, path,
+        )
+        self.autotune_report = {
+            "source": "race",
+            "cache_path": path,
+            **{
+                k: cache_entry[k]
+                for k in (
+                    "winner", "groups", "comm_op", "measured_step_s",
+                    "race", "refit",
+                )
+            },
+        }
+        return self.autotune_report
+
+    def _reducer_for(self, groups, comm_op: str, detail: str = ""):
+        """A MergedAllreduce for an EXPLICIT grouping (autotune candidates,
+        cache hits), sharing the live cost model / tb / axes / compressor
+        wiring with `_build_reducer`."""
+        cfg = self.config
+        axes = self.data_axes
+        if self.seq_axis is not None:
+            axes = axes + (self.seq_axis,)
+        comm_dtype = jnp.dtype(cfg.comm_dtype) if cfg.comm_dtype else None
+        return make_merged_allreduce(
+            self.state.params,
+            axis_name=axes,
+            policy="auto",  # only sets the tb fallback; `groups` wins
+            groups=groups,
+            policy_detail=detail,
+            tb=self._tb_cache,
+            cost_model=getattr(self, "cost_model", None),
+            comm_dtype=comm_dtype,
+            compressor=self._compressor,
+            comm_op=comm_op,
+            optim_spec=(
+                self.optim_spec if comm_op == "rs_opt_ag" else None
+            ),
+            world_size=self.data_size * self.seq_size,
+        )
+
+    def _reducer_is_live(self, groups, comm_op: str) -> bool:
+        """True when the live reducer already issues exactly this schedule
+        — skipping the rebuild avoids the tuning phase's dominant cost (a
+        fresh XLA compile) plus a sharded opt-state round trip."""
+        live = self.reducer
+        shape = tuple(tuple(int(i) for i in g) for g in groups)
+        return comm_op == live.comm_op and shape in (
+            tuple(map(tuple, live.layout.groups)),
+            tuple(map(tuple, live.schedule.groups)),
+        )
+
+    def _swap_reducer(self, reducer) -> None:
+        """Hot-swap the live merge schedule mid-run — the elastic-resize
+        re-solve seam (`update_nworker`) without the resize: gather any
+        sharded opt state to the replicated interchange form while the OLD
+        reducer still describes its layout, install the new reducer,
+        re-scatter onto its layout, rebuild the jitted steps.
+
+        Transactional: if installing the NEW reducer fails (e.g. its
+        scatter OOMs), the old reducer is restored and the opt state
+        re-scattered under its layout before the error propagates — a
+        half-installed swap would corrupt every later gather."""
+        old = self.reducer
+        self.state = self._to_checkpoint_state(self.state)
+        self.reducer = reducer
+        scattered = False
+        try:
+            self.state = self._from_checkpoint_state(self.state)
+            scattered = True
+            self._build_steps()
+        except Exception:
+            if scattered:
+                # the new layout's scatter succeeded before the failure;
+                # gather back to the interchange form under the NEW
+                # reducer before the old one re-scatters it
+                self.state = self._to_checkpoint_state(self.state)
+            self.reducer = old
+            self.state = self._from_checkpoint_state(self.state)
+            self._build_steps()
+            raise
+
+    def _apply_train_step(self, state, batch):
+        """One live train step (autotune race path), carry-aware."""
+        if self.meta.has_carry:
+            if self.carry is None:
+                self.carry = self._globalize(
+                    self.model.initial_carry(self.process_batch), axes=0
+                )
+            state, _, self.carry = self.train_step(state, batch, self.carry)
+        else:
+            state, _ = self.train_step(state, batch)
+        return state
+
+    def _autotune_batches(self):
+        """Endless stream of stacked train batches for the tuning phase —
+        real data, exactly what train_epoch would feed (every raced step is
+        a genuine training step). The shuffle epoch starts in a reserved
+        range far above any training epoch: the tuning steps must be EXTRA
+        passes over the data, not a replay of epoch 0's exact batch
+        sequence (train_epoch(0) re-seeds set_epoch(0) afterwards and
+        would otherwise double-step the same minibatches)."""
+        def gen():
+            epoch = 1 << 20  # reserved shuffle-seed range for tuning
+            nsteps = self.config.nsteps_update
+            while True:
+                self.bundle.train.set_epoch(epoch)
+                micro: list[dict] = []
+                for raw in self.bundle.train:
+                    micro.append(self._to_model_batch(raw))
+                    if len(micro) == nsteps:
+                        yield self._stack_micro(micro)
+                        micro = []
+                epoch += 1
+
+        return gen()
+
+    def _verify_live_step(self, sample_batch) -> list:
+        """Trace the LIVE jitted step abstractly and run the jaxpr
+        schedule verifier (analysis.jaxpr_check, SCH001..SCH007) against
+        the live reducer — the gate every autotune candidate must pass
+        before it may race a single real step."""
+        from mgwfbp_tpu.analysis.jaxpr_check import (
+            verify_jaxpr_against_reducer,
+        )
+
+        args = [self.state, sample_batch]
+        if self.meta.has_carry:
+            if self.carry is None:
+                self.carry = self._globalize(
+                    self.model.initial_carry(self.process_batch), axes=0
+                )
+            args.append(self.carry)
+        closed = jax.make_jaxpr(self.train_step)(*args)
+        leaves = jax.tree_util.tree_leaves(self.state.params)
+        arr = [leaves[j] for j in self.reducer.perm]
+        tag = self.reducer.schedule.policy_detail or self.config.policy
+        return verify_jaxpr_against_reducer(
+            closed, self.reducer, arr, expect_donation=True,
+            file=f"<live step {tag}>",
+        )
+
+    def _race_candidate(self, cand, batch_iter, sample_batch, steps: int):
+        """Verify one candidate, then give it warmup + `steps` real
+        training steps on the live job and record the measured step time.
+        Candidates the verifier rejects never run a step."""
+        from mgwfbp_tpu.analysis.rules import ERROR
+        from mgwfbp_tpu.parallel import autotune as at
+        from mgwfbp_tpu.profiling import time_carried_steps
+
+        pred = float(cand.predicted_total_s)
+        entry = at.RaceEntry(
+            label=cand.label,
+            comm_op=cand.comm_op,
+            num_groups=len(cand.groups),
+            predicted_total_s=None if pred != pred else pred,
+            groups=cand.groups,
+        )
+        is_live = self._reducer_is_live(cand.groups, cand.comm_op)
+        if is_live:
+            # the incumbent is already installed, burned in, and compiled —
+            # rebuilding it would waste the tuning phase's dominant cost
+            # (one XLA compile) plus a sharded-opt-state round trip
+            reducer = self.reducer
+        else:
+            try:
+                reducer = self._reducer_for(
+                    cand.groups, cand.comm_op,
+                    detail=f"autotune:{cand.label}",
+                )
+            except Exception as e:  # noqa: BLE001 — a bad candidate must
+                # not take down the tuning phase; recorded and skipped
+                self.log.warning(
+                    "autotune: candidate %s failed to build: %s",
+                    cand.label, e,
+                )
+                return entry
+        # build_layout may split dtype-mixed groups; race what is issued
+        entry.groups = reducer.layout.groups
+        entry.num_groups = reducer.layout.num_groups
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None:
+            from mgwfbp_tpu.utils.watchdog import COMPILE_ALLOW_S
+
+            wd.beat(f"autotune candidate {cand.label}",
+                    allow_s=COMPILE_ALLOW_S)
+        try:
+            if not is_live:
+                self._swap_reducer(reducer)
+            findings = self._verify_live_step(sample_batch)
+        except Exception as e:  # noqa: BLE001 — same contract as above
+            self.log.warning(
+                "autotune: candidate %s failed to swap/trace: %s",
+                cand.label, e,
+            )
+            return entry
+        errors = [f for f in findings if f.severity == ERROR]
+        if errors:
+            self.log.warning(
+                "autotune: candidate %s REJECTED by the schedule verifier "
+                "(%s)", cand.label,
+                "; ".join(f"{f.rule_id}: {f.message}" for f in errors[:3]),
+            )
+            return entry
+        entry.verified = True
+
+        def step_once(state):
+            return self._apply_train_step(state, next(batch_iter))
+
+        try:
+            self.state, dt = time_carried_steps(
+                step_once, self.state, steps, warmup=1
+            )
+        except Exception as e:  # noqa: BLE001 — a candidate that cannot
+            # execute (e.g. its compile or first dispatch fails) is
+            # skipped, not fatal: the job trains fine without it
+            deleted = any(
+                getattr(l, "is_deleted", lambda: False)()
+                for l in jax.tree_util.tree_leaves(self.state)
+            )
+            if deleted:
+                # the failing step already consumed the DONATED state
+                # buffers: there is nothing to continue training from, so
+                # skipping would only defer a confusing 'Array has been
+                # deleted' crash — fail here with the real cause attached
+                raise RuntimeError(
+                    f"autotune: candidate {cand.label} failed mid-step "
+                    "after consuming the donated train state; cannot "
+                    "continue this run"
+                ) from e
+            self.log.warning(
+                "autotune: candidate %s failed during its timed steps "
+                "(%s); skipping", cand.label, e,
+            )
+            return entry
+        self._train_step_compiled = True
+        self.iteration += steps + 1
+        entry.measured_step_s = float(dt)
+        self.log.info(
+            "autotune: %s — %d group(s), measured %.4g s/step"
+            "%s", cand.label, entry.num_groups, dt,
+            (
+                f" (predicted {entry.predicted_total_s:.4g})"
+                if entry.predicted_total_s
+                else ""
+            ),
+        )
+        return entry
+
+    def _group_observations(
+        self, batch_iter, entries, total_bytes: float, tb_total: float
+    ):
+        """(observations, source, measured_group_times) for the cost-model
+        refit. Primary path: a profiler trace of a couple more live steps,
+        attributing wall-clock to each `mgwfbp_groupNNNN` scope
+        (profiling.trace_group_times — real TPU traces keep the scope in op
+        metadata). Fallback: step-time deltas across the raced schedules
+        (autotune.step_delta_observations — the CPU-mesh regime, where
+        traces drop the name stack)."""
+        from mgwfbp_tpu.parallel import autotune as at
+        from mgwfbp_tpu.profiling import trace_group_times
+
+        num_groups = self.reducer.layout.num_groups
+        iters = 2
+
+        def run():
+            for _ in range(iters):
+                self.state = self._apply_train_step(
+                    self.state, next(batch_iter)
+                )
+            jax.block_until_ready(self.state)
+
+        measured = None
+        try:
+            measured = trace_group_times(run, num_groups, iters=iters)
+            self.iteration += iters
+        except Exception as e:  # noqa: BLE001 — profiling must never kill
+            # the tuning phase; the step-delta fallback still applies
+            self.log.info(
+                "autotune: group trace failed (%s); using step deltas", e
+            )
+        if measured is not None and num_groups >= 2:
+            layout = self.reducer.layout
+            nbytes = [
+                int(layout.group_sizes[gi])
+                * np.dtype(layout.dtypes[gi]).itemsize
+                for gi in range(num_groups)
+            ]
+            return list(zip(nbytes, measured)), "trace", measured
+        # a single-group schedule yields one trace observation — not enough
+        # for a 2-parameter fit; the raced entries span several group
+        # counts, so fall through to the step-delta pseudo-observations
+        # (measured per-group times, when any, still ride to the cache)
+        if self._tb_cache is None:
+            # step deltas subtract the backward-compute total from each
+            # measured step; the size-prior tb is a COMM prediction (the
+            # time to all-reduce the model once), not compute — subtracting
+            # it would bias the refit. Trace observations don't need tb,
+            # so only this fallback is gated on a measured profile.
+            self.log.info(
+                "autotune: refit skipped — step-delta observations need a "
+                "measured backward profile (run without "
+                "--no-profile-backward)"
+            )
+            return [], "step-deltas", measured
+        return (
+            at.step_delta_observations(entries, total_bytes, tb_total),
+            "step-deltas",
+            measured,
+        )
+
     def _apply_lm_window(self) -> None:
         """Windowed-LM length override (--num-steps): retarget the model's
         position table and the meta the batches are built from."""
@@ -460,6 +1037,7 @@ class Trainer:
 
     def _build_reducer(self, profile_backward: bool):
         cfg = self.config
+        self._compressor = None  # set below; reused by autotune candidates
         if cfg.comm_op == "hier" and (
             self.dcn_size <= 1 or self.seq_axis is not None
         ):
@@ -575,6 +1153,7 @@ class Trainer:
                 density = 1.0
                 cfg = dataclasses.replace(cfg, compressor="none")
         compressor = make_compressor(cfg.compressor, density)
+        self._compressor = compressor
         if compressor is not None:
             self.log.info(
                 "gradient compression: %s density=%g",
@@ -636,16 +1215,19 @@ class Trainer:
             compute_dtype=self.compute_dtype,
         )
         self._persist_tb(tb, names, perm)
+        source = getattr(tb, "source", "volume-prior")
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
+            from mgwfbp_tpu.profiling import TbProfile
 
             tb_arr = multihost_utils.broadcast_one_to_all(
                 np.asarray(tb, np.float64)
             )
-            tb = [float(t) for t in tb_arr]
+            tb = TbProfile((float(t) for t in tb_arr), source=source)
         self.log.info(
-            "backward benchmark: %.3g s total over %d tensors (%.1f s)",
-            sum(tb), len(tb), time.perf_counter() - t0,
+            "backward benchmark: %.3g s total over %d tensors, "
+            "per-layer source=%s (%.1f s)",
+            sum(tb), len(tb), source, time.perf_counter() - t0,
         )
         return tb
 
@@ -665,9 +1247,12 @@ class Trainer:
         with open(path, "w") as f:
             json.dump(
                 {
-                    "tb_s": tb,
+                    "tb_s": list(tb),
                     "arrival_names": [names[j] for j in perm],
                     "total_s": sum(tb),
+                    # which path produced the numbers: 'trace' (profiler
+                    # attribution) or 'volume-prior' (numel-weight split)
+                    "source": getattr(tb, "source", "volume-prior"),
                 },
                 f,
             )
@@ -1065,6 +1650,10 @@ class Trainer:
         try:
             with ProgressWatchdog() as wd:
                 self._watchdog = wd if wd.enabled else None
+                if cfg.autotune and self.autotune_report is None:
+                    # closed-loop tuning phase: the first few real steps
+                    # race candidate schedules (cache hit skips the race)
+                    self.autotune()
                 metrics = self._fit_epochs(range(self.start_epoch, end), cfg)
         finally:
             self._watchdog = None

@@ -95,6 +95,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="slices of a multi-slice pod: adds an outer "
                         "data-parallel mesh axis whose collectives cross "
                         "DCN (two-level cost model)")
+    p.add_argument("--autotune", action="store_true",
+                   help="closed-loop schedule autotuning: race verified "
+                        "candidate schedules for a few real training steps "
+                        "each, refit the cost model from the measurements, "
+                        "commit the measured argmin and cache it (see "
+                        "README 'Autotuning')")
+    p.add_argument("--autotune-steps", dest="autotune_steps", type=int,
+                   default=None,
+                   help="timed steps per raced candidate (plus one "
+                        "warmup/compile step each)")
+    p.add_argument("--schedule-cache", dest="schedule_cache", default=None,
+                   help="directory for committed autotune schedules "
+                        "(default profiles/schedule_cache); a second run "
+                        "with the same (model, world, comm-op, dtype) key "
+                        "skips the race")
     p.add_argument("--no-profile-backward", action="store_true",
                    help="skip the offline backward benchmark (size prior)")
     p.add_argument("--epochs", type=int, default=None,
@@ -117,7 +132,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "comm_profile", "dtype", "comm_dtype", "norm_clip", "lr_schedule",
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
             "num_steps", "num_batches_per_epoch", "compressor", "density",
-            "comm_op", "dcn_slices",
+            "comm_op", "dcn_slices", "autotune_steps", "schedule_cache",
         )
         if getattr(args, k, None) is not None
     }
@@ -125,6 +140,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         overrides["augment"] = False
     if args.tensorboard:
         overrides["tensorboard"] = True
+    if args.autotune:
+        overrides["autotune"] = True
     return make_config(args.dnn, **overrides)
 
 

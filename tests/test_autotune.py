@@ -1,0 +1,388 @@
+"""Closed-loop schedule autotuner: frontier, race, refit, cache, hot-swap.
+
+Runs on the 8-device virtual CPU mesh (conftest). The acceptance-shaped
+tests mirror ISSUE 3: a deliberately mis-calibrated profile plus autotune
+converges to a schedule whose measured step time matches the
+directly-solved-from-truth schedule; every candidate that races passes the
+jaxpr verifier; a second run with the same cache key skips the race; and
+candidate schedules are numerically interchangeable per step (collectives
+are bitwise-equal on the CPU mesh), so racing on live state never perturbs
+training.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mgwfbp_tpu.config import make_config
+from mgwfbp_tpu.parallel import autotune as at
+from mgwfbp_tpu.parallel.costmodel import AlphaBeta, save_profile
+from mgwfbp_tpu.parallel.solver import (
+    LayerSpec,
+    build_schedule,
+    schedule_frontier,
+    simulate_groups,
+    size_prior_tb,
+)
+from mgwfbp_tpu.train.trainer import Trainer
+
+
+# ---------------------------------------------------------------------------
+# pure helpers (no devices)
+# ---------------------------------------------------------------------------
+
+
+def test_allowed_comm_ops():
+    assert at.allowed_comm_ops("all_reduce") == ("all_reduce", "rs_ag")
+    assert at.allowed_comm_ops("rs_ag") == ("all_reduce", "rs_ag")
+    assert at.allowed_comm_ops("rs_opt_ag") == ("rs_opt_ag",)
+    assert at.allowed_comm_ops("hier") == ("hier",)
+
+
+def test_schedule_frontier_ranked_and_keeps_single():
+    sizes = [4096] * 8
+    tb = [1e-3] * 8
+    ab = AlphaBeta(alpha=1e-4, beta=1e-9)
+    frontier = schedule_frontier(
+        sizes, tb, ab.alpha, ab.predict, 4, max_candidates=3
+    )
+    assert 1 <= len(frontier) <= 3
+    # cheapest-predicted first; the first entry is the auto argmin
+    preds = [p for _, _, p in frontier]
+    assert preds[0] == min(preds)
+    # the single-group structural extreme always stays in the roster
+    assert any(len(g) == 1 and len(g[0]) == 8 for _, g, _ in frontier)
+    # predictions agree with simulate_groups under the same model
+    nbytes = [s * 4 for s in sizes]
+    for _, groups, pred in frontier:
+        total, _, _ = simulate_groups(groups, nbytes, tb, ab.predict)
+        assert pred == pytest.approx(total)
+
+
+def test_build_candidates_diverse_and_includes_incumbent():
+    specs = [LayerSpec(f"l{i}", 4096) for i in range(8)]
+    tb = [1e-3] * 8
+    ab = AlphaBeta(alpha=1e-4, beta=1e-9)
+    cands = at.build_candidates(
+        specs, tb, ab, ("all_reduce", "rs_ag"), max_candidates=2,
+        incumbent=([[0, 1, 2, 3], [4, 5, 6, 7]], "all_reduce"),
+    )
+    assert len(cands) == 2
+    # the step-delta refit needs >= 2 distinct group counts in the roster
+    assert len({len(c.groups) for c in cands}) >= 2
+    cands2 = at.build_candidates(
+        specs, tb, ab, ("all_reduce",), max_candidates=3,
+        incumbent=([[0, 2, 1, 3], [4, 5, 6, 7]], "all_reduce"),
+    )
+    # an incumbent the frontier would never generate is still raced
+    assert any(
+        c.groups == ((0, 2, 1, 3), (4, 5, 6, 7)) for c in cands2
+    )
+
+
+def test_incumbent_never_evicts_sole_shape_representative():
+    specs = [LayerSpec(f"l{i}", 4096) for i in range(8)]
+    tb = [1e-3] * 8
+    ab = AlphaBeta(alpha=1e-4, beta=1e-9)
+    for inc_groups in (
+        [[0], [1, 2, 3, 4, 5, 6, 7]],  # 2 groups, duplicate-ish count
+        [[0, 1], [2, 3], [4, 5], [6, 7]],  # 4 groups
+    ):
+        cands = at.build_candidates(
+            specs, tb, ab, ("all_reduce",), max_candidates=2,
+            incumbent=(inc_groups, "all_reduce"),
+        )
+        assert any(c.label.endswith("incumbent") for c in cands)
+        # the step-delta refit still has >= 2 distinct group counts
+        assert len({len(c.groups) for c in cands}) >= 2
+
+
+def test_cache_key_distinguishes_wire_regimes():
+    base = at.cache_key("resnet50", 8, "all_reduce", "float32")
+    assert at.cache_key(
+        "resnet50", 8, "all_reduce", "float32", comm_dtype="bfloat16"
+    ) != base
+    assert at.cache_key(
+        "resnet50", 8, "all_reduce", "float32",
+        compressor="topk", density=0.01,
+    ) != base
+    # the defaults (dense f32 wire) key exactly as before
+    assert at.cache_key(
+        "resnet50", 8, "all_reduce", "float32",
+        comm_dtype=None, compressor="none", density=1.0,
+    ) == base
+    # tb scales with the per-device batch: different batch, different key
+    assert at.cache_key(
+        "resnet50", 8, "all_reduce", "float32", batch_size=32
+    ) != at.cache_key(
+        "resnet50", 8, "all_reduce", "float32", batch_size=256
+    )
+    assert at.cache_key(
+        "resnet50", 8, "all_reduce", "float32", batch_size=32,
+        nsteps_update=1,
+    ) == at.cache_key("resnet50", 8, "all_reduce", "float32", batch_size=32)
+
+
+def test_step_delta_observations():
+    entries = [
+        at.RaceEntry("a", "all_reduce", 4, True, measured_step_s=0.02,
+                     groups=()),
+        at.RaceEntry("b", "all_reduce", 1, True, measured_step_s=0.011,
+                     groups=()),
+        at.RaceEntry("c", "all_reduce", 2, True, measured_step_s=None,
+                     groups=()),
+    ]
+    obs = at.step_delta_observations(entries, total_bytes=8e6, tb_total_s=0.01)
+    assert len(obs) == 2
+    assert obs[0] == (2e6, pytest.approx(0.0025))
+    assert obs[1] == (8e6, pytest.approx(0.001))
+    # one distinct payload only -> no fit possible -> empty
+    assert at.step_delta_observations(entries[:1], 8e6, 0.01) == []
+
+
+def test_build_schedule_explicit_groups():
+    layers = [LayerSpec(f"l{i}", 128) for i in range(3)]
+    s = build_schedule(
+        layers, [1e-3] * 3, policy="auto",
+        cost_model=AlphaBeta(1e-5, 1e-9),
+        groups=[[0, 1], [2]], policy_detail="autotune-cache:test",
+    )
+    assert s.groups == ((0, 1), (2,))
+    assert s.policy_detail == "autotune-cache:test"
+    assert np.isfinite(s.predicted_total_time)
+    with pytest.raises(ValueError, match="cover every layer"):
+        build_schedule(layers, groups=[[0], [2]])
+    with pytest.raises(ValueError, match="cover every layer"):
+        build_schedule(layers, groups=[[0, 1], [1, 2]])
+
+
+def test_cache_entry_roundtrip_and_schema_reject(tmp_path):
+    path = at.entry_path(str(tmp_path), at.cache_key("lenet", 8, "rs_ag",
+                                                     "float32"))
+    assert at.load_cache_entry(path) is None
+    at.save_cache_entry(path, {"groups": [[0, 1]], "layer_names": ["a", "b"]})
+    back = at.load_cache_entry(path)
+    assert back["groups"] == [[0, 1]]
+    assert back["schema_version"] == at.CACHE_SCHEMA_VERSION
+    doc = json.load(open(path))
+    doc["schema_version"] = 99
+    json.dump(doc, open(path, "w"))
+    with pytest.raises(ValueError, match="schema_version"):
+        at.load_cache_entry(path)
+
+
+# ---------------------------------------------------------------------------
+# live trainer loop (8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        lr=0.01, max_epochs=1, logdir="", checkpoint_dir=None, seed=3,
+        batch_size=8, policy="auto", autotune=True, autotune_steps=2,
+        autotune_candidates=2, schedule_cache=str(tmp_path / "cache"),
+    )
+    base.update(kw)
+    return make_config("lenet", **base)
+
+
+def test_autotune_smoke_two_candidates(tmp_path, capsys):
+    """The tier-1 autotune smoke (ISSUE 3 tooling satellite): 2 candidates,
+    lenet, CPU mesh — the full loop (frontier -> verify -> race -> commit
+    -> cache) plus the report tool over the committed entry."""
+    t = Trainer(_cfg(tmp_path), synthetic_data=True, profile_backward=False)
+    rep = t.autotune()
+    assert rep["source"] == "race"
+    raced = [e for e in rep["race"] if e["measured_step_s"] is not None]
+    assert len(raced) >= 2
+    # only verifier-approved candidates may race (SCH001..SCH007 gate)
+    assert all(e["verified"] for e in raced)
+    best = min(raced, key=lambda e: e["measured_step_s"])
+    assert rep["winner"] == best["label"]
+    assert rep["measured_step_s"] == best["measured_step_s"]
+    # the live reducer realizes the committed schedule
+    assert [list(g) for g in t.reducer.layout.groups] == rep["groups"]
+    # committed entry on disk, schema-stamped, loadable
+    entry = at.load_cache_entry(rep["cache_path"])
+    assert entry["groups"] == rep["groups"]
+    assert entry["winner"] == rep["winner"]
+    assert entry["tb_source"] == "size-prior"
+    # the report tool renders it
+    import autotune_report
+
+    assert autotune_report.main([rep["cache_path"]]) == 0
+    out = capsys.readouterr().out
+    assert "committed winner" in out
+    assert "race:" in out
+    assert rep["winner"] in out
+
+
+def test_autotune_miscalibrated_profile_converges_and_caches(tmp_path):
+    """Acceptance: alpha/beta off by 10x + autotune -> committed schedule's
+    measured step time within 5% of the directly-solved-from-truth
+    schedule; a second run with the same cache key skips the race."""
+    from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mgwfbp_tpu.profiling import profile_allreduce, time_carried_steps
+
+    mesh = make_mesh(MeshSpec(data=8, seq=1))
+    prof = profile_allreduce(
+        mesh, sizes=(1 << 12, 1 << 15, 1 << 18), warmup=1, iters=3
+    )
+    # overlap 0: compute and collective thunks serialize on the CPU mesh
+    truth = AlphaBeta(
+        alpha=prof.model.alpha, beta=prof.model.beta, overlap=0.0
+    )
+    bad = AlphaBeta(
+        alpha=truth.alpha * 10.0, beta=truth.beta * 10.0, overlap=0.0
+    )
+    bad_path = tmp_path / "bad.json"
+    save_profile(str(bad_path), bad)
+
+    cfg = _cfg(
+        tmp_path, comm_profile=str(bad_path), autotune_candidates=4,
+    )
+    # measured tb (profile_backward=True): the step-delta refit is gated on
+    # a MEASURED backward profile (a size-prior tb is a comm prediction)
+    t = Trainer(cfg, synthetic_data=True)
+    rep = t.autotune()
+    assert rep["source"] == "race"
+    raced = [e for e in rep["race"] if e["measured_step_s"] is not None]
+    assert raced and all(e["verified"] for e in raced)
+    # the cost model was refit from live observations and recorded
+    assert rep["refit"] is not None
+    assert rep["refit"]["source"] in ("trace", "step-deltas")
+    assert rep["refit"]["after"]["alpha"] != rep["refit"]["before"]["alpha"]
+
+    # the directly-solved-from-truth schedule
+    names = list(t.reducer.schedule.layer_names)
+    leaves = jax.tree_util.tree_leaves(t.state.params)
+    arr = [leaves[j] for j in t.reducer.perm]
+    specs = [
+        LayerSpec(nm, int(np.prod(l.shape)), jnp.dtype(l.dtype).itemsize)
+        for nm, l in zip(names, arr)
+    ]
+    truth_sched = build_schedule(
+        specs, size_prior_tb(specs, truth), policy="auto", cost_model=truth
+    )
+    truth_shape = tuple(tuple(g) for g in truth_sched.groups)
+    win_shape = tuple(tuple(g) for g in rep["groups"])
+
+    raced = {
+        (e["comm_op"], tuple(tuple(g) for g in e["groups"])): e
+        for e in rep["race"]
+        if e["measured_step_s"] is not None
+    }
+    truth_entry = raced.get(("all_reduce", truth_shape))
+    if win_shape == truth_shape and rep["comm_op"] == "all_reduce":
+        pass  # converged to the truth-solved schedule exactly
+    elif truth_entry is not None:
+        # the truth schedule raced under the same protocol/phase as the
+        # winner — same-phase measurements are the fair 5% comparison
+        # (back-to-back fresh timings drift with suite-wide host load)
+        assert rep["measured_step_s"] <= (
+            truth_entry["measured_step_s"] * 1.05
+        ), (rep["measured_step_s"], truth_entry["measured_step_s"])
+    else:
+        # rare path: truth shape never raced — measure both fresh, with
+        # the windows INTERLEAVED so host-load drift cancels
+        batch_iter = t._autotune_batches()
+
+        def window(groups, comm_op):
+            t._swap_reducer(t._reducer_for(
+                tuple(tuple(g) for g in groups), comm_op, detail="measure"
+            ))
+            t.state = t._apply_train_step(t.state, next(batch_iter))
+            jax.block_until_ready(t.state)
+            t.state, dt = time_carried_steps(
+                lambda s: t._apply_train_step(s, next(batch_iter)),
+                t.state, 3, warmup=0,
+            )
+            return dt
+
+        dt_truth = float("inf")
+        dt_committed = float("inf")
+        for _ in range(3):
+            dt_truth = min(dt_truth, window(truth_shape, "all_reduce"))
+            dt_committed = min(
+                dt_committed, window(win_shape, rep["comm_op"])
+            )
+        assert dt_committed <= dt_truth * 1.05, (
+            dt_committed, dt_truth, win_shape, truth_shape,
+        )
+
+    # second run, same cache key: no race, committed schedule loads
+    t2 = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    rep2 = t2.autotune()
+    assert rep2["source"] == "cache"
+    assert rep2["groups"] == rep["groups"]
+    assert rep2["comm_op"] == rep["comm_op"]
+    assert [list(g) for g in t2.reducer.layout.groups] == rep["groups"]
+
+
+def test_race_runtime_failure_is_contained(tmp_path, monkeypatch):
+    """A candidate that cannot execute (OOM, compile failure) is skipped,
+    not fatal — and with no survivor the solved schedule is restored."""
+    import mgwfbp_tpu.profiling as prof
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic OOM")
+
+    monkeypatch.setattr(prof, "time_carried_steps", boom)
+    t = Trainer(_cfg(tmp_path), synthetic_data=True, profile_backward=False)
+    orig_groups = t.reducer.layout.groups
+    rep = t.autotune()  # must not raise
+    assert rep["cache_path"] is None
+    assert all(e["measured_step_s"] is None for e in rep["race"])
+    assert t.reducer.layout.groups == orig_groups  # original restored
+
+
+def test_candidate_schedules_bitwise_identical_updates(mesh8):
+    """Racing candidates on LIVE state is safe because every candidate
+    computes the same update: collectives are bitwise-equal on the CPU
+    mesh, and regrouping only changes pack order, not per-element math."""
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.optim import make_optimizer
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+    from mgwfbp_tpu.parallel.mesh import DATA_AXIS
+    from mgwfbp_tpu.train.step import create_train_state, make_train_step
+
+    model, meta = zoo.create_model("lenet")
+    tx, _ = make_optimizer(
+        0.01, momentum=0.9, weight_decay=1e-4, lr_schedule="const",
+        dataset="mnist", num_batches_per_epoch=1,
+    )
+    state = create_train_state(
+        jax.random.PRNGKey(0), model,
+        jnp.zeros((1,) + tuple(meta.input_shape), meta.input_dtype), tx,
+    )
+    n = len(jax.tree_util.tree_leaves(state.params))
+    rs = np.random.RandomState(0)
+    batch = {
+        "x": jnp.asarray(
+            rs.randn(1, 16, *meta.input_shape).astype(np.float32)
+        ),
+        "y": jnp.asarray(rs.randint(0, 10, (1, 16)), jnp.int32),
+    }
+    cases = [
+        ([[i] for i in range(n)], "all_reduce"),  # wfbp shape
+        ([list(range(n))], "all_reduce"),  # single
+        ([list(range(n))], "rs_ag"),  # same shape, other lowering
+    ]
+    results = []
+    for groups, comm_op in cases:
+        red = make_merged_allreduce(
+            state.params, axis_name=DATA_AXIS, policy="auto", groups=groups,
+            cost_model=AlphaBeta(1e-5, 1e-10), comm_op=comm_op,
+        )
+        step = make_train_step(model, meta, tx, mesh8, red, donate=False)
+        new_state, _ = step(state, batch)
+        results.append([
+            np.asarray(l)
+            for l in jax.tree_util.tree_leaves(new_state.params)
+        ])
+    for other in results[1:]:
+        for a, b in zip(results[0], other):
+            np.testing.assert_array_equal(a, b)

@@ -1,0 +1,76 @@
+"""bench.py chip-outage handling (ISSUE 3 satellite): a timed-out backend
+init retries with exponential backoff and then SKIPS with a structured
+record (exit 0) instead of rc=1 — the perf trajectory must distinguish
+"no chip this round" from a regression (BENCH_r01..r05 carried the outage
+as indistinguishable null metrics)."""
+
+import json
+
+import pytest
+
+import bench
+from mgwfbp_tpu.utils import platform as plat
+
+
+def test_init_timeout_retries_then_chip_unavailable(monkeypatch):
+    calls = {"n": 0}
+
+    def fake_run_with_deadline(fn, timeout_s, what="operation"):
+        calls["n"] += 1
+        raise plat.DeadlineExceeded(f"{what} timed out")
+
+    monkeypatch.setattr(plat, "run_with_deadline", fake_run_with_deadline)
+    cleared = []
+    monkeypatch.setattr(
+        "jax.extend.backend.clear_backends",
+        lambda: cleared.append(1), raising=False,
+    )
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    with pytest.raises(bench.ChipUnavailable, match="chip/tunnel unavailable"):
+        bench._devices_with_retry(init_timeout_s=1.0)
+    assert calls["n"] == 3  # bounded retry: 3 attempts
+    assert sleeps == [30.0, 60.0]  # exponential backoff between them
+    # the abandoned init thread still holds jax's backend lock on the
+    # timeout path; clear_backends would deadlock — must NOT be called
+    assert cleared == []
+
+
+def test_transient_init_error_still_retries_then_raises(monkeypatch):
+    def fake_run_with_deadline(fn, timeout_s, what="operation"):
+        raise RuntimeError("Unable to initialize backend")
+
+    monkeypatch.setattr(plat, "run_with_deadline", fake_run_with_deadline)
+    monkeypatch.setattr(
+        "jax.extend.backend.clear_backends", lambda: None, raising=False
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # non-timeout failures keep the old contract: RuntimeError, rc=1 path
+    with pytest.raises(RuntimeError, match="after 4 attempts"):
+        bench._devices_with_retry(init_timeout_s=1.0)
+
+
+def test_main_emits_structured_skip_record(monkeypatch, capsys):
+    def raise_unavailable():
+        raise bench.ChipUnavailable("backend init timed out x3")
+
+    monkeypatch.setattr(bench, "run_bench", raise_unavailable)
+    rc = bench.main()
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0  # a skip is NOT a failure
+    assert payload["skipped"] == "chip unavailable"
+    assert payload["value"] is None
+    assert "error" not in payload
+    assert "timed out" in payload["detail"]
+
+
+def test_main_real_errors_stay_rc1(monkeypatch, capsys):
+    def boom():
+        raise RuntimeError("genuine breakage")
+
+    monkeypatch.setattr(bench, "run_bench", boom)
+    rc = bench.main()
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1
+    assert "genuine breakage" in payload["error"]
+    assert "skipped" not in payload

@@ -1,0 +1,73 @@
+"""Calibrate CLI coverage (ISSUE 3 satellite): flag validation + profile
+round-trips — previously the CLI had no tests at all."""
+
+import json
+
+import pytest
+
+from mgwfbp_tpu import calibrate
+from mgwfbp_tpu.parallel.costmodel import (
+    PROFILE_SCHEMA_VERSION,
+    SampledCost,
+    load_profile,
+)
+
+
+def test_prior_extend_and_world_sizes_mutually_exclusive(tmp_path, capsys):
+    with pytest.raises(SystemExit) as ei:
+        calibrate.main([
+            "--out", str(tmp_path / "p.json"),
+            "--prior-extend", "ici", "--world-sizes", "2,4",
+        ])
+    assert ei.value.code == 2  # argparse usage error
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_world_sizes_beyond_available_devices_exits_cleanly(tmp_path):
+    out = tmp_path / "p.json"
+    with pytest.raises(SystemExit) as ei:
+        calibrate.main([
+            "--out", str(out), "--world-sizes", "64",
+            "--min-log2", "10", "--max-log2", "11",
+            "--iters", "1", "--warmup", "0", "--no-gamma", "--no-overlap",
+        ])
+    assert "devices available" in str(ei.value)
+    assert not out.exists()  # no half-written profile
+
+
+def test_calibrate_profile_roundtrips(tmp_path, capsys):
+    out = tmp_path / "prof.json"
+    rc = calibrate.main([
+        "--out", str(out), "--min-log2", "10", "--max-log2", "12",
+        "--iters", "2", "--warmup", "1", "--no-gamma", "--no-overlap",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["samples"] == 3
+    assert report["out"] == str(out)
+    # round-trip through save_profile/load_profile
+    m = load_profile(str(out))
+    assert isinstance(m, SampledCost)
+    assert m.alpha == pytest.approx(report["alpha_s"])
+    assert m.beta == pytest.approx(report["beta_s_per_byte"])
+    assert m.gamma == 0.0 and m.pack_beta == 0.0 and m.update_beta == 0.0
+    assert m.predict(2048 * 4) > 0.0
+    doc = json.load(open(out))
+    assert doc["schema_version"] == PROFILE_SCHEMA_VERSION
+    assert doc["meta"]["n_devices"] == 8
+
+
+def test_calibrate_world_sizes_family_roundtrips(tmp_path, capsys):
+    out = tmp_path / "fam.json"
+    rc = calibrate.main([
+        "--out", str(out), "--world-sizes", "2",
+        "--min-log2", "10", "--max-log2", "11",
+        "--iters", "1", "--warmup", "1", "--no-gamma", "--no-overlap",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "2" in report["family"]
+    fam = load_profile(str(out))
+    pinned = fam.at(2)
+    assert isinstance(pinned, SampledCost)
+    assert pinned.alpha == pytest.approx(report["family"]["2"]["alpha_s"])

@@ -226,3 +226,65 @@ def test_sampled_cost_curve_and_roundtrip(tmp_path):
     assert isinstance(mid, AlphaBeta)
     assert mid.gamma == pytest.approx(1.5e-4)
     assert mid.overlap == pytest.approx(0.625)
+
+
+def test_profile_schema_version_stamped_legacy_and_rejected(tmp_path):
+    import json
+
+    from mgwfbp_tpu.parallel.costmodel import PROFILE_SCHEMA_VERSION
+
+    p = tmp_path / "prof.json"
+    save_profile(str(p), AlphaBeta(1e-5, 2e-11))
+    doc = json.load(open(p))
+    assert doc["schema_version"] == PROFILE_SCHEMA_VERSION
+    # legacy pre-stamp files (v1) migrate transparently
+    legacy = {k: v for k, v in doc.items() if k != "schema_version"}
+    p2 = tmp_path / "legacy.json"
+    json.dump(legacy, open(p2, "w"))
+    m = load_profile(str(p2))
+    assert m.alpha == pytest.approx(1e-5)
+    # unknown (newer) versions are rejected with a clear error
+    doc["schema_version"] = 99
+    json.dump(doc, open(p, "w"))
+    with pytest.raises(ValueError, match="schema_version 99"):
+        load_profile(str(p))
+    # ... and non-integer stamps too
+    doc["schema_version"] = "2"
+    json.dump(doc, open(p, "w"))
+    with pytest.raises(ValueError, match="schema_version"):
+        load_profile(str(p))
+
+
+def test_refit_from_observations_recovers_constants():
+    from mgwfbp_tpu.parallel.costmodel import refit_from_observations
+
+    alpha, beta, gamma = 2e-3, 5e-9, 1e-4
+    # observed per-collective wall clock includes the gamma overhead the
+    # solver charges separately -> the refit subtracts it from the intercept
+    obs = [(b, alpha + gamma + beta * b) for b in (1e4, 1e5, 1e6, 1e7)]
+    old = AlphaBeta(1.0, 1.0, gamma=gamma, overlap=0.25, pack_beta=7e-12)
+    m = refit_from_observations(old, obs)
+    assert m.alpha == pytest.approx(alpha, rel=1e-6)
+    assert m.beta == pytest.approx(beta, rel=1e-6)
+    # microbench-fit fields carry over untouched
+    assert m.gamma == gamma
+    assert m.overlap == 0.25
+    assert m.pack_beta == 7e-12
+    with pytest.raises(ValueError, match="two"):
+        refit_from_observations(old, obs[:1])
+
+
+def test_refit_splits_update_beta_on_rs_opt_ag():
+    from mgwfbp_tpu.parallel.costmodel import refit_from_observations
+
+    old = AlphaBeta(1e-3, 3e-9, update_beta=1e-9)
+    obs = [(b, 5e-4 + 8e-9 * b) for b in (1e4, 1e6, 1e8)]
+    m = refit_from_observations(old, obs, comm_op="rs_opt_ag")
+    # fitted rate covers beta + update_beta jointly; split keeps the old
+    # proportions (observations cannot separate wire from update)
+    assert m.beta + m.update_beta == pytest.approx(8e-9)
+    assert m.update_beta == pytest.approx(8e-9 * 0.25)
+    # on the plain lowerings update_beta passes through unchanged
+    m2 = refit_from_observations(old, obs, comm_op="all_reduce")
+    assert m2.update_beta == 1e-9
+    assert m2.beta == pytest.approx(8e-9)
