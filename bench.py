@@ -300,7 +300,7 @@ def _bench_policy(
     if not (loss == loss):  # NaN guard: timing a diverged program is moot
         raise RuntimeError(f"policy {policy}: non-finite loss in timed loop")
     groups = reducer.schedule.num_groups if reducer is not None else 0
-    return dt, groups, flops
+    return dt, groups, flops, reducer
 
 
 def run_bench() -> dict:
@@ -382,9 +382,10 @@ def run_bench() -> dict:
             warmup=2, iters=5, names=names, compute_dtype=compute_dtype,
         )
         grid: dict[str, dict] = {}
+        reducers: dict[str, object] = {}
         for policy in _POLICIES:
             _progress(f"policy {policy}: build + compile + time")
-            dt, groups, flops = _bench_policy(
+            dt, groups, flops, reducer = _bench_policy(
                 policy, make_state, model, meta, tx, mesh, bd, tb_prof,
                 iters, compute_dtype=compute_dtype, cost_model=cost_model,
             )
@@ -394,18 +395,19 @@ def run_bench() -> dict:
                 "merge_groups": groups,
                 "flops_per_step": flops,
             }
-        return gb, tb_prof, grid
+            reducers[policy] = reducer
+        return gb, tb_prof, grid, reducers
 
     batch_fallback = False
     try:
-        global_batch, tb, results = run_grid(batch)
+        global_batch, tb, results, reducers = run_grid(batch)
     except Exception as e:
         if not (_is_oom(e) and batch > 64):
             raise
         # preset batch doesn't fit this chip: rerun the ENTIRE grid at 64
         batch_fallback = True
         batch = 64
-        global_batch, tb, results = run_grid(batch)
+        global_batch, tb, results, reducers = run_grid(batch)
 
     # Headline = the PRODUCTION configuration. On one device the Trainer
     # skips the reducer entirely (reference single-path parity:
@@ -451,6 +453,21 @@ def run_bench() -> dict:
         payload["mfu"] = round(mfu, 4)
     if flops is not None:
         payload["flops_per_step"] = flops
+    headline_reducer = reducers.get(headline_policy)
+    if headline_reducer is not None:
+        # overlap-efficiency summary for the headline configuration (the
+        # paper's hidden-vs-exposed comm accounting, telemetry/overlap.py)
+        # — cost-model-attributed here: the bench loop is not traced
+        from mgwfbp_tpu.telemetry import summarize as overlap_summarize
+
+        s = overlap_summarize(headline_reducer, cost_model, list(tb), dt)
+        payload["overlap"] = {
+            "comm_s": round(s.comm_s, 6),
+            "hidden_s": round(s.hidden_s, 6),
+            "exposed_s": round(s.exposed_s, 6),
+            "efficiency": round(s.efficiency, 4),
+            "attribution": s.attribution,
+        }
     if n_dev == 1:
         payload["note"] = (
             "single chip: headline is the PRODUCTION configuration — the "
@@ -478,6 +495,26 @@ def run_bench() -> dict:
     return payload
 
 
+def _record_bench_skip(detail: str) -> None:
+    """Append a structured bench_skip record to the telemetry stream at
+    MGWFBP_TELEMETRY_DIR (when set) — the same typed event family live
+    runs write, so outage post-mortems grep one format."""
+    d = os.environ.get("MGWFBP_TELEMETRY_DIR")
+    if not d:
+        return
+    try:
+        from mgwfbp_tpu.telemetry import EventWriter
+
+        w = EventWriter(
+            os.path.join(d, "telemetry.jsonl"), run={"source": "bench"}
+        )
+        w.emit("bench_skip", detail=detail)
+        w.close()
+    except Exception:  # noqa: BLE001 — observability must not turn a
+        # structured skip (rc=0) into a crash (rc=1)
+        pass
+
+
 def main() -> int:
     try:
         payload = run_bench()
@@ -487,6 +524,7 @@ def main() -> int:
         # structured skip, exit 0: the trajectory reads "no chip this
         # round", not "regression" — a null metric with rc=1 is
         # indistinguishable from real breakage (BENCH_r01..r05)
+        _record_bench_skip(f"{type(e).__name__}: {e}")
         _emit(
             {
                 "metric": "resnet50_synthetic_imagenet_train_throughput",

@@ -44,6 +44,21 @@ _WALLCLOCK_CALLS = {
 
 _TRACED_MODULE_ROOTS = ("jnp.", "jax.", "lax.")
 
+# JIT006: telemetry/logging emitters — host I/O that a traced body would
+# run exactly once (at trace time) instead of per step, silently dropping
+# every later record; real telemetry belongs OUTSIDE the jitted step
+# (telemetry/events.py). The sets are deliberately shaped like the
+# project's emitters: stdlib/logging-style method calls on a logger-ish
+# receiver, the ScalarWriter surface, and EventWriter.emit on a
+# telemetry-ish receiver. `jax.debug.print` (a traced callback) is NOT
+# matched — only the bare Python `print`.
+_LOGGING_METHODS = {
+    "debug", "info", "warning", "error", "critical", "exception", "log",
+}
+_LOGGING_ROOT_SEGMENTS = {"log", "logger", "logging"}
+_TELEMETRY_METHODS = {"add_scalar", "add_scalars"}
+_TELEMETRY_EMIT_SEGMENTS = {"telemetry", "tel", "writer", "events"}
+
 # jax APIs that operate on pytree STRUCTURE, not traced values — a Python
 # branch on these is static and legal (e.g. `if tree_leaves(bstats):`)
 _STRUCTURAL_PREFIXES = (
@@ -182,6 +197,24 @@ class _TracedBodyChecker(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
 
+    def _is_telemetry_call(self, fn: str) -> bool:
+        parts = fn.split(".")
+        tail = parts[-1]
+        receiver = parts[:-1]
+        if fn == "print":
+            return True
+        if tail in _LOGGING_METHODS and any(
+            p in _LOGGING_ROOT_SEGMENTS for p in receiver
+        ):
+            return True
+        if tail in _TELEMETRY_METHODS:
+            return True
+        if tail == "emit" and any(
+            p in _TELEMETRY_EMIT_SEGMENTS for p in receiver
+        ):
+            return True
+        return False
+
     def visit_Call(self, node: ast.Call) -> None:
         fn = _dotted(node.func)
         if fn is not None:
@@ -192,6 +225,13 @@ class _TracedBodyChecker(ast.NodeVisitor):
             elif fn.startswith(("np.random.", "numpy.random.")):
                 self._add(node, "JIT002",
                           f"'{fn}()' inside traced '{self.fn.name}'")
+            elif self._is_telemetry_call(fn):
+                self._add(
+                    node, "JIT006",
+                    f"'{fn}()' inside traced '{self.fn.name}' — host I/O "
+                    "runs once at trace time, not per step; emit telemetry "
+                    "outside jit",
+                )
             elif fn in ("float", "int", "bool") and node.args:
                 if _rooted_at(node.args[0], self.params) or (
                     _contains_traced_call(node.args[0])

@@ -72,6 +72,9 @@ _register("JIT004", WARNING,
           "Python-level branch on a traced value (use lax.cond/jnp.where)")
 _register("JIT005", ERROR,
           "mutable default argument on a jitted function (shared across traces)")
+_register("JIT006", ERROR,
+          "telemetry/logging call inside traced code (host I/O runs once at "
+          "trace time and never per step — emit spans outside jit)")
 
 # --- jaxpr schedule-verifier rules -----------------------------------------
 _register("SCH001", ERROR,

@@ -82,6 +82,11 @@ class TrainConfig:
     logdir: str = "./logs"
     tensorboard: bool = False  # scalar event stream (reference's disabled
     # tensorboardX seam, dist_trainer.py:136-137 — live here as JSONL)
+    telemetry: bool = False  # structured run observability (telemetry/):
+    # step spans, per-group comm spans + overlap-efficiency snapshots,
+    # autotune/resize/checkpoint/watchdog events — one schema-versioned
+    # JSONL per run, rendered by tools/telemetry_report.py
+    telemetry_dir: Optional[str] = None  # events dir; default <logdir>/<tag>
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 1
     pretrain: Optional[str] = None

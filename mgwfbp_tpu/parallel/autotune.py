@@ -32,7 +32,8 @@ hot-swap; everything schedule-shaped and cache-shaped lives here):
      race;
   5. commit — the measured argmin is hot-swapped in (the elastic-resize
      re-solve seam) and persisted in a schedule cache keyed by
-     (model, world size, comm_op, dtype) under profiles/, so subsequent
+     the full non-portable parameter set (authoritative field list:
+     `cache_key`'s docstring) under profiles/, so subsequent
      runs skip the search and cold-start on the tuned schedule.
 """
 
@@ -227,7 +228,8 @@ def model_summary(model) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# Schedule cache: committed winners, keyed by (model, world, comm_op, dtype).
+# Schedule cache: committed winners, keyed by `cache_key` (its docstring
+# is the single authoritative statement of the keyed fields).
 # ---------------------------------------------------------------------------
 
 
@@ -246,14 +248,31 @@ def cache_key(
     batch_size: Optional[int] = None,
     nsteps_update: Optional[int] = None,
 ) -> str:
-    """Filename-safe cache key. The keyed fields are exactly the ones a
-    schedule is NOT portable across: the layer set rides inside the entry
-    (validated on load), the world size changes the cost constants, the
-    lowering changes the collective contract, the dtypes / compressor
-    change the wire bytes the race optimized for — a winner tuned at bf16
-    wire or 1% density must not be served to an f32 dense run — and the
-    per-device batch (plus accumulation depth) scales tb, which moves the
-    compute/comm balance the grouping was tuned for."""
+    """Filename-safe cache key — THE single authoritative statement of
+    what a committed schedule is keyed by (README/ROADMAP refer here
+    instead of restating it).
+
+    The key is, in filename order:
+
+      * ``model`` — the architecture (its layer set also rides inside the
+        entry and is re-validated on load);
+      * ``world`` — the data-parallel world size (changes the alpha-beta
+        cost constants);
+      * ``comm_op`` — the bucket lowering (changes the collective
+        contract);
+      * ``dtype`` — the compute/param dtype;
+      * ``batch_size`` (``_b<N>``) and, when > 1, ``nsteps_update``
+        (``_acc<N>``) — the per-device batch and accumulation depth scale
+        tb, which moves the compute/comm balance the grouping was tuned
+        for;
+      * when set: ``comm_dtype`` (``_wire-<dtype>``) and
+        ``compressor``/``density`` — they change the wire bytes the race
+        optimized for (a winner tuned at bf16 wire or 1% density must not
+        be served to an f32 dense run).
+
+    These are exactly the fields a schedule is NOT portable across;
+    everything else (seed, logdir, epochs, ...) is deliberately excluded.
+    """
     key = f"{_safe(model)}_w{int(world)}_{_safe(comm_op)}_{_safe(dtype)}"
     if batch_size is not None:
         key += f"_b{int(batch_size)}"

@@ -1,0 +1,37 @@
+"""Run-observability subsystem: typed event stream, overlap-efficiency
+accounting, Chrome-trace / Prometheus export.
+
+Every layer feeds one append-only, schema-versioned JSONL stream per run
+(`telemetry/events.py`); `telemetry/overlap.py` turns per-group comm times
+(trace-attributed or cost-model-predicted) into the paper's exposed-vs-
+hidden accounting; `telemetry/export.py` renders the stream for Perfetto
+and Prometheus; `tools/telemetry_report.py` prints the human summary.
+"""
+
+from mgwfbp_tpu.telemetry.events import (
+    EVENT_SCHEMA_VERSION,
+    EVENT_TYPES,
+    EventWriter,
+    events_of,
+    read_events,
+)
+from mgwfbp_tpu.telemetry.overlap import (
+    GroupOverlap,
+    OverlapSummary,
+    attribute_overlap,
+    group_comm_times,
+    summarize,
+)
+
+__all__ = [
+    "EVENT_SCHEMA_VERSION",
+    "EVENT_TYPES",
+    "EventWriter",
+    "events_of",
+    "read_events",
+    "GroupOverlap",
+    "OverlapSummary",
+    "attribute_overlap",
+    "group_comm_times",
+    "summarize",
+]

@@ -1,0 +1,237 @@
+"""Structured run-observability event stream.
+
+MG-WFBP's whole claim is that the merged schedule *hides* communication
+behind the backward pass (arXiv:1811.11141); a production run must be able
+to show that it actually does. This module is the spine of the telemetry
+subsystem: an append-only, schema-versioned JSONL stream of TYPED records
+every layer of the framework feeds — step spans from the trainer's (un-jitted)
+step loop, per-merge-group comm spans with exposed/hidden attribution
+(`telemetry.overlap`), autotune race rows, elastic resizes, checkpoint
+saves, watchdog stalls, bench skips — so a post-mortem, an overlap report
+(`tools/telemetry_report.py`), and a Chrome-trace render
+(`telemetry.export`) all read from ONE greppable file.
+
+Wire format: line 1 is a ``header`` record carrying ``schema_version``
+(validated by the same `check_schema_version` the calibration profiles and
+the schedule cache use); every following line is one event object::
+
+    {"event": "step", "wall": 1722760000.1, "step": 12, "epoch": 0,
+     "start_s": 3.41, "dur_s": 0.021}
+
+Hot-path discipline: the writer NEVER touches the device. ``emit`` rejects
+any field value that is not a plain JSON scalar/list/dict — handing it a
+jax array (whose serialization would force a device sync) raises
+``TypeError`` instead of silently stalling the step loop. Step spans are
+host wall-clock around the *dispatch* of the async jitted step: once the
+dispatch pipeline fills, their cadence equals realized step throughput,
+and no block_until_ready / device_get is ever issued on their behalf
+(enforced by the zero-sync guard in tests/test_telemetry.py and lint rule
+JIT006 for the jitted side).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from mgwfbp_tpu.parallel.costmodel import check_schema_version
+
+# Version 1 is the legacy headerless ScalarWriter JSONL
+# ({"wall","step","tag","value"} rows, utils/summary.py) — `read_events`
+# migrates it to `scalar` records. Version 2 is the typed stream below.
+EVENT_SCHEMA_VERSION = 2
+_LEGACY_SCALAR_VERSION = 1
+
+# Typed records: event name -> required fields (beyond "event"/"wall").
+# Extra fields are allowed — the schema names the invariants a reader may
+# rely on, not the exhaustive payload.
+EVENT_TYPES: dict[str, tuple[str, ...]] = {
+    # run metadata; always the stream's first record
+    "header": ("schema_version",),
+    # one optimizer step: host wall-clock span around the async dispatch,
+    # start_s relative to the stream's epoch (header wall)
+    "step": ("step", "epoch", "start_s", "dur_s"),
+    # one merge group's comm span within the step timeline (model-replayed
+    # start, measured or predicted duration; see telemetry.overlap)
+    "comm_group": ("step", "group", "nbytes", "comm_s", "start_s",
+                   "hidden_s", "exposed_s", "attribution"),
+    # aggregate overlap-efficiency snapshot for the surrounding step regime
+    "overlap": ("step", "epoch", "step_s", "tb_total_s", "comm_s",
+                "hidden_s", "exposed_s", "efficiency", "attribution"),
+    # ScalarWriter view: the legacy scalar rows, now in the same stream
+    "scalar": ("tag", "value", "step"),
+    # epoch boundary (throughput trend anchor for the report CLI)
+    "epoch": ("epoch", "steps", "dur_s"),
+    # autotune: one raced candidate / the committed winner
+    "autotune_race": ("label", "comm_op", "num_groups", "verified",
+                      "measured_step_s"),
+    "autotune_commit": ("winner", "comm_op", "num_groups", "source"),
+    # elastic resize seam; schedule_source records which path won the
+    # post-resize schedule ("schedule-cache" vs "solver")
+    "resize": ("old_world", "new_world", "schedule_source", "num_groups"),
+    "checkpoint": ("epoch", "iteration"),
+    # watchdog stall/abort (also CRITICAL-logged; this makes it greppable
+    # from the same file as the step records)
+    "watchdog_stall": ("phase", "idle_s", "timeout_s", "abort"),
+    # bench.py structured skip (chip unavailable)
+    "bench_skip": ("detail",),
+}
+
+_JSON_SCALARS = (str, int, float, bool, type(None))
+
+
+def _check_jsonable(value, key: str) -> None:
+    """Reject anything that is not already host-side JSON data.
+
+    A device array here would force a host transfer during serialization —
+    exactly the sync the telemetry contract forbids — so it fails loudly at
+    the emit site instead."""
+    if isinstance(value, _JSON_SCALARS):
+        return
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _check_jsonable(v, f"{key}[{i}]")
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _check_jsonable(v, f"{key}.{k}")
+        return
+    raise TypeError(
+        f"telemetry field {key!r} is {type(value).__name__}, not plain JSON "
+        "data; convert device values on a cold path first (telemetry must "
+        "add zero device syncs to the step loop)"
+    )
+
+
+class EventWriter:
+    """Append-only JSONL event stream (one run, process 0).
+
+    Writes the versioned header when it creates (or first appends to an
+    empty) file; re-opening an existing stream appends without a second
+    header. Thread-safe for concurrent emitters (the watchdog fires from
+    its daemon thread) — each record is one line-buffered write.
+    """
+
+    def __init__(self, path: str, run: Optional[dict] = None):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fresh = not (os.path.exists(path) and os.path.getsize(path) > 0)
+        header_wall = None
+        if not fresh:
+            # re-opening (resume under the same tag): span timestamps stay
+            # relative to the ORIGINAL header's wall clock, so appended
+            # records extend the stream's timeline instead of restarting
+            # at zero on top of the first run's spans
+            try:
+                with open(path) as f:
+                    first = json.loads(f.readline())
+                if first.get("event") == "header":
+                    header_wall = float(first.get("wall", 0.0)) or None
+            except (OSError, ValueError):
+                header_wall = None
+        self._f = open(path, "a", buffering=1)  # line-buffered
+        self._lock = threading.Lock()
+        # stream-relative clock for span timestamps: monotonic, immune to
+        # wall-clock steps mid-run; anchored at the stream header's wall
+        self._t0 = time.perf_counter()
+        if header_wall is not None:
+            self._t0 -= max(time.time() - header_wall, 0.0)
+        if fresh:
+            self.emit(
+                "header",
+                schema_version=EVENT_SCHEMA_VERSION,
+                run=dict(run or {}),
+            )
+
+    def now(self) -> float:
+        """Seconds since this writer opened (span-timestamp base)."""
+        return time.perf_counter() - self._t0
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one typed record. Unknown event names and missing
+        required fields raise — a misspelled emitter must fail its test,
+        not write rows no reader understands."""
+        required = EVENT_TYPES.get(event)
+        if required is None:
+            raise ValueError(
+                f"unknown telemetry event {event!r}; known: "
+                f"{sorted(EVENT_TYPES)}"
+            )
+        missing = [k for k in required if k not in fields]
+        if missing:
+            raise ValueError(
+                f"telemetry event {event!r} missing required field(s) "
+                f"{missing}"
+            )
+        for k, v in fields.items():
+            _check_jsonable(v, k)
+        rec = {"event": event, "wall": round(time.time(), 3), **fields}
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            if not self._f.closed:
+                self._f.write(line)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def migrate_legacy_scalars(rows: list[dict]) -> list[dict]:
+    """Lift a v1 (headerless ScalarWriter) stream into v2 records."""
+    out = []
+    for r in rows:
+        out.append({
+            "event": "scalar",
+            "wall": r.get("wall", 0.0),
+            "tag": r.get("tag", ""),
+            "value": r.get("value"),
+            "step": r.get("step", 0),
+        })
+    return out
+
+
+def read_events(path: str) -> list[dict]:
+    """Load a telemetry stream, validating (and migrating) its schema.
+
+    * v2 stream (leading ``header`` record): version-checked via
+      `check_schema_version`; returns all records including the header.
+    * v1 legacy stream (headerless ScalarWriter JSONL): each row migrates
+      to a ``scalar`` record and a synthesized v2 header is prepended.
+    * Anything stamped with a version this build does not read raises
+      ValueError — a newer writer's file must fail loudly.
+    """
+    rows: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        return []
+    first = rows[0]
+    if first.get("event") == "header" or "schema_version" in first:
+        check_schema_version(
+            first, path=path, supported=(EVENT_SCHEMA_VERSION,),
+            what="telemetry event stream",
+        )
+        return rows
+    # headerless: the legacy scalar layout (or garbage, which json.loads
+    # above would already have rejected line-wise)
+    migrated = migrate_legacy_scalars(rows)
+    header = {
+        "event": "header",
+        "wall": migrated[0].get("wall", 0.0),
+        "schema_version": EVENT_SCHEMA_VERSION,
+        "run": {"migrated_from": _LEGACY_SCALAR_VERSION},
+    }
+    return [header] + migrated
+
+
+def events_of(records: list[dict], *names: str) -> list[dict]:
+    """Filter records by event type (reader-side convenience)."""
+    want = set(names)
+    return [r for r in records if r.get("event") in want]

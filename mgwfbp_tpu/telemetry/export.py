@@ -1,0 +1,195 @@
+"""Render a telemetry event stream for external viewers.
+
+Two targets:
+
+  * **Chrome trace** (`chrome://tracing` / Perfetto): the run's step
+    timeline as complete ("ph": "X") events — a ``steps`` track of step
+    spans, a ``backward`` track, one track per merge group's collective,
+    and an ``optimizer`` track. Step spans come straight from the recorded
+    host wall-clock; the intra-step structure is the overlap snapshot's
+    replayed timeline (telemetry.overlap) scaled into each step span, so
+    what Perfetto shows per step is exactly what the overlap accounting
+    charged: where each group's comm sat relative to backward, and how
+    much stuck out past it.
+  * **Prometheus text exposition**: counters/gauges summarizing the same
+    stream (steps, step seconds, overlap efficiency, exposed/hidden comm,
+    resizes, checkpoints, watchdog stalls) for scrape-style monitoring.
+
+Both are pure functions of the already-written JSONL records — no live run
+required, no device access ever.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from mgwfbp_tpu.telemetry.events import events_of
+
+# fixed track (tid) layout; merge-group tracks follow from _TID_GROUP0
+_TID_STEPS = 0
+_TID_BACKWARD = 1
+_TID_OPTIMIZER = 2
+_TID_GROUP0 = 10
+_PID = 1
+
+
+def _meta(name: str, pid: int, tid: Optional[int] = None, *,
+          kind: str) -> dict:
+    e: dict = {"ph": "M", "pid": pid, "name": kind,
+               "args": {"name": name}}
+    if tid is not None:
+        e["tid"] = tid
+    return e
+
+
+def _span(name: str, tid: int, ts_us: float, dur_us: float,
+          args: Optional[dict] = None) -> dict:
+    e = {"ph": "X", "pid": _PID, "tid": tid, "name": name,
+         "ts": round(ts_us, 3), "dur": round(max(dur_us, 0.0), 3),
+         "cat": "mgwfbp"}
+    if args:
+        e["args"] = args
+    return e
+
+
+def latest_snapshot(records: list[dict]) -> tuple[Optional[dict], list[dict]]:
+    """(last overlap record, its comm_group rows) — the schedule regime the
+    intra-step render uses. comm_group rows are matched by the snapshot's
+    step id, so a mid-run reschedule (autotune/resize) renders with the
+    regime that was actually live last. Shared by this exporter and the
+    report CLI so the table and the trace can never disagree on which
+    regime they show."""
+    overlaps = events_of(records, "overlap")
+    if not overlaps:
+        return None, []
+    snap = overlaps[-1]
+    rows = [
+        r for r in events_of(records, "comm_group")
+        if r.get("step") == snap.get("step")
+    ]
+    rows.sort(key=lambda r: r.get("group", 0))
+    return snap, rows
+
+
+def chrome_trace(records: list[dict]) -> dict:
+    """Chrome-trace JSON object for a telemetry record list."""
+    trace: list[dict] = [
+        _meta("mgwfbp run", _PID, kind="process_name"),
+        _meta("steps", _PID, _TID_STEPS, kind="thread_name"),
+        _meta("backward", _PID, _TID_BACKWARD, kind="thread_name"),
+        _meta("optimizer", _PID, _TID_OPTIMIZER, kind="thread_name"),
+    ]
+    snap, group_rows = latest_snapshot(records)
+    for r in group_rows:
+        gi = int(r["group"])
+        trace.append(_meta(
+            f"comm group {gi:04d}", _PID, _TID_GROUP0 + gi,
+            kind="thread_name",
+        ))
+    for s in events_of(records, "step"):
+        ts = float(s["start_s"]) * 1e6
+        dur = float(s["dur_s"]) * 1e6
+        trace.append(_span(
+            f"step {int(s['step'])}", _TID_STEPS, ts, dur,
+            args={"epoch": s.get("epoch")},
+        ))
+        if snap is None:
+            continue
+        # scale the replayed model timeline (backward + comm + optimizer
+        # tail) into this step's real span, so sub-spans nest inside it
+        step_model_s = max(float(snap.get("step_s", 0.0)), 1e-12)
+        scale = (dur / 1e6) / step_model_s
+        tb_total = float(snap.get("tb_total_s", 0.0))
+        trace.append(_span(
+            "backward", _TID_BACKWARD, ts, tb_total * scale * 1e6,
+        ))
+        for r in group_rows:
+            gi = int(r["group"])
+            trace.append(_span(
+                f"group {gi:04d} ({r.get('attribution', '?')})",
+                _TID_GROUP0 + gi,
+                ts + float(r["start_s"]) * scale * 1e6,
+                float(r["comm_s"]) * scale * 1e6,
+                args={
+                    "nbytes": r.get("nbytes"),
+                    "hidden_s": r.get("hidden_s"),
+                    "exposed_s": r.get("exposed_s"),
+                },
+            ))
+        timeline_end = float(snap.get("timeline_end_s", tb_total))
+        opt_s = max(step_model_s - timeline_end, 0.0)
+        if opt_s > 0.0:
+            trace.append(_span(
+                "optimizer/update", _TID_OPTIMIZER,
+                ts + timeline_end * scale * 1e6, opt_s * scale * 1e6,
+            ))
+    header = next(iter(events_of(records, "header")), {})
+    return {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "mgwfbp_tpu.telemetry",
+            "schema_version": header.get("schema_version"),
+            "run": header.get("run", {}),
+        },
+    }
+
+
+def write_chrome_trace(path: str, records: list[dict]) -> dict:
+    doc = chrome_trace(records)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def prometheus_text(records: list[dict]) -> str:
+    """Prometheus text-exposition dump of the stream's counters/gauges."""
+    steps = events_of(records, "step")
+    overlaps = events_of(records, "overlap")
+    snap = overlaps[-1] if overlaps else None
+
+    lines: list[str] = []
+
+    def metric(name: str, kind: str, help_: str, value) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value:g}" if isinstance(value, float)
+                     else f"{name} {value}")
+
+    metric("mgwfbp_steps_total", "counter",
+           "optimizer steps recorded in the telemetry stream", len(steps))
+    if steps:
+        recent = steps[-min(len(steps), 20):]
+        mean = sum(float(s["dur_s"]) for s in recent) / len(recent)
+        metric("mgwfbp_step_seconds", "gauge",
+               "mean seconds per step over the last spans", float(mean))
+    if snap is not None:
+        metric("mgwfbp_overlap_efficiency", "gauge",
+               "hidden / total communication time (latest snapshot)",
+               float(snap.get("efficiency", 0.0)))
+        metric("mgwfbp_comm_hidden_seconds", "gauge",
+               "per-step communication hidden behind backward (latest)",
+               float(snap.get("hidden_s", 0.0)))
+        metric("mgwfbp_comm_exposed_seconds", "gauge",
+               "per-step communication on the critical path (latest)",
+               float(snap.get("exposed_s", 0.0)))
+    for name, ev, help_ in (
+        ("mgwfbp_resizes_total", "resize", "elastic worker-count resizes"),
+        ("mgwfbp_checkpoints_total", "checkpoint", "checkpoint saves"),
+        ("mgwfbp_watchdog_stalls_total", "watchdog_stall",
+         "watchdog stall detections"),
+        ("mgwfbp_autotune_races_total", "autotune_race",
+         "autotune candidates raced"),
+        ("mgwfbp_bench_skips_total", "bench_skip",
+         "bench runs skipped (chip unavailable)"),
+    ):
+        metric(name, "counter", help_, len(events_of(records, ev)))
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str, records: list[dict]) -> str:
+    text = prometheus_text(records)
+    with open(path, "w") as f:
+        f.write(text)
+    return text

@@ -1,0 +1,214 @@
+"""Overlap-efficiency accounting: exposed vs hidden communication time.
+
+The paper's headline metric, made measurable on any run. MG-WFBP merges
+gradients so each bucket's collective starts as soon as its last member's
+gradient is ready and rides *behind* the rest of the backward pass
+(arXiv:1811.11141); DeAR frames the next wins as reasoning about exactly
+which collective time is exposed vs overlapped (arXiv:2302.12445). This
+module replays the step timeline the solver reasons about — gradient-ready
+times from the per-layer backward profile tb, one serial link occupied by
+the merge groups in arrival order — and splits every group's communication
+time into
+
+  * **hidden**: the part that executes while backward compute is still
+    running (start .. backward end), and
+  * **exposed**: the remainder, which lands on the step's critical path.
+
+The aggregate **overlap efficiency** is hidden / total comm — 1.0 when the
+schedule hides everything, 0.0 when every byte serializes after backward.
+
+Per-group comm durations come from two attribution sources, combined by
+`group_comm_times`:
+
+  * **trace** — `profiling.trace_group_times`: profiler-trace events whose
+    op metadata carries the `mgwfbp_groupNNNN` name scope (real TPU; the
+    same introspection hook the jaxpr verifier matches on);
+  * **cost-model** — the calibrated alpha-beta prediction per bucket
+    (`solver.effective_cost_fn`), the fallback on backends whose traces
+    drop the name stack (the virtual CPU mesh).
+
+Everything here is pure host arithmetic over already-host data: calling it
+adds zero device syncs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupOverlap:
+    """One merge group's share of the replayed step timeline."""
+
+    group: int  # arrival-order group index
+    nbytes: int  # bucket payload on the wire
+    start_s: float  # link-timeline start (ready[max member], link free)
+    comm_s: float  # collective duration (measured or predicted)
+    hidden_s: float  # portion overlapping backward compute
+    exposed_s: float  # portion after backward end (critical path)
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapSummary:
+    """Per-step overlap accounting for one schedule regime."""
+
+    step_s: float  # measured seconds per optimizer step
+    tb_total_s: float  # backward compute total (sum of tb)
+    groups: tuple[GroupOverlap, ...]
+    attribution: str  # 'trace' | 'cost-model'
+
+    @property
+    def comm_s(self) -> float:
+        return sum(g.comm_s for g in self.groups)
+
+    @property
+    def hidden_s(self) -> float:
+        return sum(g.hidden_s for g in self.groups)
+
+    @property
+    def exposed_s(self) -> float:
+        return sum(g.exposed_s for g in self.groups)
+
+    @property
+    def efficiency(self) -> float:
+        """hidden / total comm; a comm-free step is perfectly hidden."""
+        total = self.comm_s
+        if total <= 0.0:
+            return 1.0
+        return self.hidden_s / total
+
+    @property
+    def timeline_end_s(self) -> float:
+        """End of the replayed bwd+comm timeline (export's render span)."""
+        last_comm = max((g.start_s + g.comm_s for g in self.groups),
+                        default=0.0)
+        return max(self.tb_total_s, last_comm)
+
+    def to_event_fields(self) -> dict:
+        """The aggregate `overlap` telemetry record's payload."""
+        return {
+            "step_s": float(self.step_s),
+            "tb_total_s": float(self.tb_total_s),
+            "comm_s": float(self.comm_s),
+            "hidden_s": float(self.hidden_s),
+            "exposed_s": float(self.exposed_s),
+            "efficiency": float(self.efficiency),
+            "attribution": self.attribution,
+            "timeline_end_s": float(self.timeline_end_s),
+            "num_groups": len(self.groups),
+        }
+
+    def group_event_fields(self, step: int) -> list[dict]:
+        """One `comm_group` telemetry record payload per merge group."""
+        return [
+            {
+                "step": int(step),
+                "group": g.group,
+                "nbytes": int(g.nbytes),
+                "comm_s": float(g.comm_s),
+                "start_s": float(g.start_s),
+                "hidden_s": float(g.hidden_s),
+                "exposed_s": float(g.exposed_s),
+                "attribution": self.attribution,
+            }
+            for g in self.groups
+        ]
+
+
+def attribute_overlap(
+    groups: Sequence[Sequence[int]],
+    tb: Sequence[float],
+    comm_s: Sequence[float],
+    nbytes: Sequence[int],
+) -> list[GroupOverlap]:
+    """Replay the backward/comm timeline and split each group's comm time.
+
+    The recurrence is the solver's (`solver.simulate_groups`, itself the
+    reference's taoc recurrence, distributed_optimizer.py:187-192): group
+    g's collective starts at max(link free, ready[max(g)]) where ready is
+    the cumulative backward profile; the part of [start, start + comm)
+    before the backward end is hidden, the rest exposed. Durations may be
+    measured (trace) or predicted (cost model); starts are always
+    model-replayed — a trace yields per-scope totals, not start offsets.
+    """
+    if len(groups) != len(comm_s) or len(groups) != len(nbytes):
+        raise ValueError(
+            f"groups/comm_s/nbytes disagree: {len(groups)}/"
+            f"{len(comm_s)}/{len(nbytes)}"
+        )
+    ready = np.cumsum(np.asarray(tb, dtype=np.float64))
+    bwd_end = float(ready[-1]) if len(ready) else 0.0
+    link_free = 0.0
+    out: list[GroupOverlap] = []
+    for gi, g in enumerate(groups):
+        t = float(comm_s[gi])
+        ready_at = float(ready[max(g)]) if len(g) and len(ready) else 0.0
+        start = max(link_free, ready_at)
+        hidden = min(max(bwd_end - start, 0.0), t)
+        out.append(GroupOverlap(
+            group=gi,
+            nbytes=int(nbytes[gi]),
+            start_s=start,
+            comm_s=t,
+            hidden_s=hidden,
+            exposed_s=t - hidden,
+        ))
+        link_free = start + t
+    return out
+
+
+def group_comm_times(
+    reducer,
+    cost_model,
+    measured: Optional[Sequence[float]] = None,
+) -> tuple[list[float], list[int], str]:
+    """(per-group seconds, per-group bytes, attribution) for a live reducer.
+
+    `measured` is trace-attributed per-group wall-clock in layout order
+    (`profiling.trace_group_times`) when the backend kept the
+    `mgwfbp_groupNNNN` scopes in op metadata; otherwise the calibrated cost
+    model predicts each bucket (`solver.effective_cost_fn`, which prices
+    the rs_opt_ag update-in-the-middle consistently).
+    """
+    import numpy as _np
+
+    from mgwfbp_tpu.parallel.solver import effective_cost_fn
+
+    layout = reducer.layout
+    nbytes = [
+        int(layout.group_sizes[gi])
+        * int(_np.dtype(layout.dtypes[gi]).itemsize)
+        for gi in range(layout.num_groups)
+    ]
+    if measured is not None and len(measured) == layout.num_groups:
+        return [float(t) for t in measured], nbytes, "trace"
+    cost = effective_cost_fn(cost_model, reducer.comm_op)
+    return [float(cost(b)) for b in nbytes], nbytes, "cost-model"
+
+
+def summarize(
+    reducer,
+    cost_model,
+    tb: Sequence[float],
+    step_s: float,
+    measured: Optional[Sequence[float]] = None,
+) -> OverlapSummary:
+    """Full overlap accounting for one live schedule regime.
+
+    tb is the arrival-ordered per-layer backward profile (measured, or the
+    size prior the solver fell back to); step_s the measured seconds per
+    optimizer step the snapshot describes.
+    """
+    comm, nbytes, attribution = group_comm_times(
+        reducer, cost_model, measured
+    )
+    rows = attribute_overlap(reducer.layout.groups, tb, comm, nbytes)
+    return OverlapSummary(
+        step_s=float(step_s),
+        tb_total_s=float(sum(float(t) for t in tb)),
+        groups=tuple(rows),
+        attribution=attribution,
+    )

@@ -144,6 +144,11 @@ class Trainer:
             self.tx,
         )
         self._tb_cache = None  # measured backward profile, reused on resize
+        # trace-attributed per-group comm seconds (layout order) for the
+        # LIVE schedule, when a profiler trace has measured them (autotune,
+        # or the opt-in MGWFBP_TELEMETRY_TRACE snapshot); telemetry's
+        # overlap accounting prefers these over cost-model predictions
+        self._measured_group_times = None
         # first-dispatch flags: the initial call of each step program
         # compiles (long, silent); the watchdog gets an extended deadline
         # for exactly that phase (ADVICE r4 #3)
@@ -329,15 +334,219 @@ class Trainer:
         old_writer = getattr(self, "writer", None)
         if old_writer is not None:
             old_writer.close()
+        old_tel = getattr(self, "telemetry", None)
+        if old_tel is not None:
+            old_tel.close()
+        # telemetry event stream (telemetry/events.py): process 0 only,
+        # one schema-versioned JSONL per tagged run — step spans, overlap
+        # snapshots, resizes, checkpoints, watchdog stalls all land here
+        self.telemetry = None
+        tel_dir = config.telemetry_dir or (
+            os.path.join(config.logdir, config.tag())
+            if config.logdir
+            else None
+        )
+        if config.telemetry and jax.process_index() == 0:
+            if tel_dir is None:
+                self.log.warning(
+                    "--telemetry requested but neither --telemetry-dir nor "
+                    "--logdir is set; telemetry disabled"
+                )
+            else:
+                from mgwfbp_tpu.telemetry import EventWriter
+
+                self.telemetry = EventWriter(
+                    os.path.join(tel_dir, "telemetry.jsonl"),
+                    run={
+                        "model": config.dnn,
+                        "dataset": config.dataset,
+                        "world": self.data_size * self.seq_size,
+                        "comm_op": config.comm_op,
+                        "policy": config.policy,
+                        "tag": config.tag(),
+                    },
+                )
         # scalar event stream (reference's tensorboardX seam, live):
-        # process 0 only, like the reference's rank-gated writer
+        # process 0 only, like the reference's rank-gated writer. With
+        # telemetry on, the ScalarWriter is a thin view over the SAME
+        # stream (scalar records), so one file holds the whole run.
         self.writer = None
         if config.tensorboard and config.logdir and jax.process_index() == 0:
             from mgwfbp_tpu.utils.summary import ScalarWriter
 
             self.writer = ScalarWriter(
-                os.path.join(config.logdir, config.tag())
+                os.path.join(config.logdir, config.tag()),
+                stream=self.telemetry,
             )
+
+    # ------------------------------------------------------------------
+    # Telemetry (mgwfbp_tpu/telemetry/): every emission below is host-only
+    # arithmetic over already-host data — the step loop gains ZERO device
+    # syncs from telemetry (enforced by tests/test_telemetry.py's guard and
+    # lint rule JIT006 on the jitted side).
+    # ------------------------------------------------------------------
+
+    def _emit_event(self, event: str, **fields) -> None:
+        """Append one telemetry record; schema misuse (unknown event,
+        missing field, device value) raises — that is a bug — but I/O
+        failure only disables the stream, never the training run."""
+        if self.telemetry is None:
+            return
+        try:
+            self.telemetry.emit(event, **fields)
+        except (TypeError, ValueError):
+            raise
+        except Exception as e:  # noqa: BLE001 — disk full / fs gone
+            self.log.warning("telemetry write failed (%s); disabling", e)
+            self.telemetry = None
+
+    def _layer_specs(self) -> list:
+        """Arrival-ordered LayerSpecs of the live reducer's layer set
+        (shared by the autotuner's frontier and the overlap tb prior)."""
+        from mgwfbp_tpu.parallel.solver import LayerSpec
+
+        leaves = jax.tree_util.tree_leaves(self.state.params)
+        arr = [leaves[j] for j in self.reducer.perm]
+        return [
+            LayerSpec(
+                name=nm,
+                size=int(np.prod(l.shape)) if l.shape else 1,
+                itemsize=jnp.dtype(l.dtype).itemsize,
+            )
+            for nm, l in zip(self.reducer.schedule.layer_names, arr)
+        ]
+
+    def _overlap_tb(self) -> Optional[list]:
+        """Arrival-ordered per-layer backward seconds for the overlap
+        replay: the measured profile when one exists, else the same
+        size-prior the solver fell back to (so accounting and schedule
+        always reason from the same timeline)."""
+        if self._tb_cache is not None:
+            return list(self._tb_cache)
+        from mgwfbp_tpu.parallel.solver import size_prior_tb
+
+        return size_prior_tb(
+            self._layer_specs(), getattr(self, "cost_model", None)
+        )
+
+    def _emit_overlap_snapshot(
+        self, step_s: float, step: int, epoch: int
+    ) -> None:
+        """Overlap-efficiency accounting for the current schedule regime:
+        one aggregate `overlap` record plus one `comm_group` record per
+        merge group (exposed vs hidden comm — README 'Telemetry')."""
+        if self.telemetry is None or self.reducer is None:
+            return
+        cost_model = getattr(self, "cost_model", None)
+        if cost_model is None or step_s <= 0.0:
+            return
+        from mgwfbp_tpu import telemetry as tel
+
+        measured = self._measured_group_times
+        if measured is not None and len(measured) != (
+            self.reducer.layout.num_groups
+        ):
+            measured = None  # traced under a since-replaced schedule
+        summary = tel.summarize(
+            self.reducer, cost_model, self._overlap_tb(), step_s,
+            measured=measured,
+        )
+        self._emit_event(
+            "overlap", step=int(step), epoch=int(epoch),
+            **summary.to_event_fields(),
+        )
+        for fields in summary.group_event_fields(int(step)):
+            self._emit_event("comm_group", **fields)
+        self.log.info(
+            "overlap snapshot (%s): %.4g s comm/step = %.4g hidden + %.4g "
+            "exposed -> efficiency %.3f",
+            summary.attribution, summary.comm_s, summary.hidden_s,
+            summary.exposed_s, summary.efficiency,
+        )
+
+    def _measure_group_times_live(self, iters: int = 2) -> None:
+        """Opt-in (MGWFBP_TELEMETRY_TRACE=1) trace attribution of per-group
+        comm from a couple of live steps. This DOES sync the device, so it
+        runs once before the epoch loop — never inside it; on backends
+        whose traces drop the name stack (CPU mesh) it yields nothing and
+        overlap accounting stays on the cost model."""
+        if self.reducer is None:
+            return
+        from mgwfbp_tpu.profiling import trace_group_times
+
+        batch_iter = self._autotune_batches()
+
+        def run():
+            for _ in range(iters):
+                self.state = self._apply_train_step(
+                    self.state, next(batch_iter)
+                )
+            jax.block_until_ready(self.state)
+
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None and not self._train_step_compiled:
+            from mgwfbp_tpu.utils.watchdog import COMPILE_ALLOW_S
+
+            wd.beat("telemetry group trace", allow_s=COMPILE_ALLOW_S)
+        try:
+            measured = trace_group_times(
+                run, self.reducer.layout.num_groups, iters=iters
+            )
+        except Exception as e:  # noqa: BLE001 — observability must never
+            # kill the run it observes
+            self.log.info("telemetry group trace failed (%s)", e)
+            return
+        self.iteration += iters
+        self._train_step_compiled = True
+        if measured is not None:
+            self._measured_group_times = measured
+            self.log.info(
+                "telemetry: trace attributed %d group comm time(s)",
+                len(measured),
+            )
+
+    def _on_watchdog_stall(
+        self, phase: str, idle_s: float, timeout_s: float, abort: bool
+    ) -> None:
+        """Watchdog stall/abort -> structured event in the run's stream
+        (post-mortems of a wedged device grep ONE file, not stderr)."""
+        self._emit_event(
+            "watchdog_stall", phase=str(phase), idle_s=float(idle_s),
+            timeout_s=float(timeout_s), abort=bool(abort),
+        )
+
+    def _cached_schedule_entry(self):
+        """(entry, path) of a committed autotune schedule for the CURRENT
+        (model, world, ...) cache key whose layer set matches the live
+        model, else None — the elastic-resize seam consults this before
+        settling for the freshly solved schedule."""
+        from mgwfbp_tpu.parallel import autotune as at
+
+        if self.reducer is None:
+            return None
+        cfg = self.config
+        cache_dir = cfg.schedule_cache or os.path.join(
+            "profiles", "schedule_cache"
+        )
+        key = at.cache_key(
+            cfg.dnn, self.data_size * self.seq_size, cfg.comm_op, cfg.dtype,
+            comm_dtype=cfg.comm_dtype,
+            compressor=cfg.compressor, density=cfg.density,
+            batch_size=cfg.batch_size, nsteps_update=cfg.nsteps_update,
+        )
+        path = at.entry_path(cache_dir, key)
+        try:
+            entry = at.load_cache_entry(path)
+        except ValueError as e:
+            self.log.warning("schedule cache entry unreadable: %s", e)
+            return None
+        if entry is None:
+            return None
+        if entry.get("layer_names") != list(
+            self.reducer.schedule.layer_names
+        ):
+            return None
+        return entry, path
 
     def _steps_per_epoch(self) -> int:
         """Optimizer steps per epoch: loader batches / nsteps_update, capped
@@ -421,17 +630,54 @@ class Trainer:
         # is unchanged, so the existing opt_state (momentum) carries over
         self._build_optimizer()
         self.reducer = self._build_reducer(self._profile_backward_enabled)
+        self._measured_group_times = None  # traced under the old schedule
+        # a tuned entry for the NEW world size beats the fresh solve: the
+        # autotuner measured it on a live job at exactly this key, so
+        # consult the schedule cache before settling for the solver
+        schedule_source = "solver"
+        cached = self._cached_schedule_entry()
+        if cached is not None:
+            entry, path = cached
+            try:
+                self.reducer = self._reducer_for(
+                    tuple(tuple(int(i) for i in g) for g in entry["groups"]),
+                    entry["comm_op"],
+                    detail=f"schedule-cache:{entry.get('winner', 'winner')}",
+                )
+            except Exception as e:  # noqa: BLE001 — a stale/corrupt entry
+                # must degrade to the solved schedule, not kill the resize
+                self.log.warning(
+                    "schedule cache entry %s failed to build (%s); "
+                    "keeping the solved schedule", path, e,
+                )
+            else:
+                schedule_source = "schedule-cache"
+                self.log.info(
+                    "update_nworker: tuned schedule loaded from %s "
+                    "(%d groups, comm_op=%s)", path,
+                    self.reducer.layout.num_groups, self.reducer.comm_op,
+                )
         self.state = self._from_checkpoint_state(self.state)
         self._build_steps()
         # the run tag changed with nworkers: re-point log/checkpoint/event
         # sinks so post-resize output is found by a relaunch at the new size
         self._build_run_sinks()
+        self._emit_event(
+            "resize", old_world=int(old), new_world=int(nworkers),
+            schedule_source=schedule_source if self.reducer is not None
+            else "none",
+            num_groups=(
+                self.reducer.layout.num_groups
+                if self.reducer is not None else 0
+            ),
+        )
         self.carry = None  # old carry is sized for the old process batch
         self.log.info(
             "update_nworker: resized data axis %d -> %d (process batch %d%s)",
             old, nworkers, self.process_batch,
             "" if self.reducer is None
-            else f", merge schedule re-solved: {self.reducer.schedule.num_groups} groups",
+            else f", merge schedule {schedule_source}: "
+                 f"{self.reducer.schedule.num_groups} groups",
         )
 
     # ------------------------------------------------------------------
@@ -448,7 +694,8 @@ class Trainer:
         steps each (state carried through — no step is paused or lost),
         refits the cost model from the measurements, re-solves once, and
         commits the measured argmin, persisting it in the schedule cache
-        keyed by (model, world, comm_op, dtype). A later run with the same
+        keyed by the schedule-cache key (authoritative field list:
+        `parallel.autotune.cache_key`). A later run with the same
         key skips the race and cold-starts on the committed schedule.
 
         Returns the report dict (also kept as self.autotune_report), or
@@ -458,9 +705,7 @@ class Trainer:
 
         from mgwfbp_tpu.parallel import autotune as at
         from mgwfbp_tpu.parallel.costmodel import refit_from_observations
-        from mgwfbp_tpu.parallel.solver import (
-            LayerSpec, build_schedule, size_prior_tb,
-        )
+        from mgwfbp_tpu.parallel.solver import build_schedule, size_prior_tb
 
         cfg = self.config
         if self.reducer is None:
@@ -506,6 +751,17 @@ class Trainer:
                 "(%d groups, comm_op=%s), race skipped",
                 path, len(groups), entry["comm_op"],
             )
+            mgt = entry.get("measured_group_times")
+            if mgt:
+                # the entry's trace-attributed group times describe the
+                # schedule just installed; telemetry's overlap accounting
+                # can use them instead of cost-model predictions
+                self._measured_group_times = [float(t) for t in mgt]
+            self._emit_event(
+                "autotune_commit", winner=str(entry.get("winner")),
+                comm_op=str(entry["comm_op"]), num_groups=len(groups),
+                source="cache",
+            )
             self.autotune_report = {
                 "source": "cache", "cache_path": path,
                 "comm_op": entry["comm_op"],
@@ -520,16 +776,7 @@ class Trainer:
             )
 
         # ---- frontier ------------------------------------------------
-        leaves = jax.tree_util.tree_leaves(self.state.params)
-        arr = [leaves[j] for j in self.reducer.perm]
-        specs = [
-            LayerSpec(
-                name=nm,
-                size=int(np.prod(l.shape)) if l.shape else 1,
-                itemsize=jnp.dtype(l.dtype).itemsize,
-            )
-            for nm, l in zip(names_now, arr)
-        ]
+        specs = self._layer_specs()
         cost_model = getattr(self, "cost_model", None)
         tb = (
             list(self._tb_cache)
@@ -657,6 +904,8 @@ class Trainer:
             )
             if self.reducer is not original:
                 self._swap_reducer(original)
+            for e in entries:
+                self._emit_event("autotune_race", **e.to_json())
             self.autotune_report = {
                 "source": "race", "cache_path": None,
                 "race": [e.to_json() for e in entries],
@@ -696,6 +945,22 @@ class Trainer:
             "measured_group_times": measured_groups,
         }
         at.save_cache_entry(path, cache_entry)
+        # trace-attributed group times (when the backend supplied any)
+        # describe the NOW-LIVE winner; hand them to the overlap accounting
+        self._measured_group_times = (
+            [float(t) for t in measured_groups]
+            if measured_groups is not None
+            else None
+        )
+        # race rows land in the stream too, so tools/autotune_report.py and
+        # tools/telemetry_report.py tell the same story
+        for e in entries:
+            self._emit_event("autotune_race", **e.to_json())
+        self._emit_event(
+            "autotune_commit", winner=winner.label,
+            comm_op=winner.comm_op, num_groups=len(winner.groups),
+            source="race",
+        )
         self.log.info(
             "autotune: committed %s (%d groups, comm_op=%s, %.4g s/step) "
             "-> %s", winner.label, len(winner.groups), winner.comm_op,
@@ -764,6 +1029,7 @@ class Trainer:
         half-installed swap would corrupt every later gather."""
         old = self.reducer
         self.state = self._to_checkpoint_state(self.state)
+        self._measured_group_times = None  # traced under the old schedule
         self.reducer = reducer
         scattered = False
         try:
@@ -1341,6 +1607,13 @@ class Trainer:
 
                 wd.beat(f"compile train step (epoch {epoch})",
                         allow_s=COMPILE_ALLOW_S)
+            # step span: host wall-clock around the ASYNC dispatch, emitted
+            # outside jit — no block_until_ready, no device_get (telemetry
+            # adds zero device syncs; once the dispatch pipeline fills,
+            # span cadence equals realized step throughput)
+            span0 = (
+                self.telemetry.now() if self.telemetry is not None else 0.0
+            )
             if self.meta.has_carry:
                 self.state, metrics, self.carry = self.train_step(
                     self.state, batch, self.carry
@@ -1351,6 +1624,12 @@ class Trainer:
             if wd is not None:
                 wd.beat(wd_phase)
             self.iteration += 1
+            if self.telemetry is not None:
+                self._emit_event(
+                    "step", step=int(self.iteration), epoch=int(epoch),
+                    start_s=float(span0),
+                    dur_s=float(self.telemetry.now() - span0),
+                )
             window_iters += 1
             epoch_steps += 1
             if max_steps is not None and epoch_steps >= max_steps:
@@ -1385,6 +1664,19 @@ class Trainer:
                 "epoch %d: dropped %d trailing micro-batch(es) "
                 "(loader length %% nsteps_update=%d != 0)",
                 epoch, len(micro), nsteps,
+            )
+        if self.telemetry is not None and epoch_steps > 0:
+            epoch_dur = time.time() - t_epoch
+            self._emit_event(
+                "epoch", epoch=int(epoch), steps=int(epoch_steps),
+                dur_s=float(epoch_dur),
+            )
+            # overlap-efficiency snapshot for this epoch's schedule regime
+            # (pure host arithmetic: measured step cadence + per-group comm
+            # times — trace-attributed when available, cost-model otherwise)
+            self._emit_overlap_snapshot(
+                step_s=epoch_dur / epoch_steps,
+                step=int(self.iteration), epoch=int(epoch),
             )
         metrics = {k: float(v) for k, v in metrics.items()}
         self.log.info(
@@ -1561,12 +1853,18 @@ class Trainer:
                     iteration=self.iteration,
                 )
             )
+            self._emit_event(
+                "checkpoint", epoch=int(epoch),
+                iteration=int(self.iteration),
+            )
 
     def close(self) -> None:
         if self.checkpointer is not None:
             self.checkpointer.close()
         if self.writer is not None:
             self.writer.close()
+        if self.telemetry is not None:
+            self.telemetry.close()
 
     def load_checkpoint(self, directory: str, epoch: Optional[int] = None):
         """Restore a snapshot from a checkpoint dir onto this trainer's mesh
@@ -1648,12 +1946,28 @@ class Trainer:
         from mgwfbp_tpu.utils.watchdog import ProgressWatchdog
 
         try:
-            with ProgressWatchdog() as wd:
+            # stalls also land in the telemetry stream (structured
+            # watchdog_stall events), greppable next to the step records
+            with ProgressWatchdog(on_stall=self._on_watchdog_stall) as wd:
                 self._watchdog = wd if wd.enabled else None
                 if cfg.autotune and self.autotune_report is None:
                     # closed-loop tuning phase: the first few real steps
                     # race candidate schedules (cache hit skips the race)
                     self.autotune()
+                if (
+                    self.telemetry is not None
+                    # single-process only: the traced steps issue REAL
+                    # collectives, and the telemetry writer exists only on
+                    # process 0 — gating the steps on it would advance one
+                    # process ahead of the others (distributed hang)
+                    and jax.process_count() == 1
+                    and self._measured_group_times is None
+                    and os.environ.get("MGWFBP_TELEMETRY_TRACE") == "1"
+                ):
+                    # opt-in: trace-attribute per-group comm from a couple
+                    # of live steps BEFORE the epoch loop (this one syncs;
+                    # the loop itself never does)
+                    self._measure_group_times_live()
                 metrics = self._fit_epochs(range(self.start_epoch, end), cfg)
         finally:
             self._watchdog = None

@@ -77,6 +77,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream scalar events to <logdir>/<tag>/events.jsonl "
                         "(mirrors into TensorBoard files if tensorboardX is "
                         "installed)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="structured run observability: step spans, per-group "
+                        "comm spans with exposed/hidden overlap accounting, "
+                        "autotune/resize/checkpoint/watchdog events — one "
+                        "schema-versioned JSONL per run; render with "
+                        "tools/telemetry_report.py (README 'Telemetry')")
+    p.add_argument("--telemetry-dir", dest="telemetry_dir", default=None,
+                   help="directory for the telemetry event stream (default "
+                        "<logdir>/<tag>; implies --telemetry)")
     p.add_argument("--compressor", default=None,
                    choices=["none", "topk"],
                    help="gradient compressor (reference --compressor)")
@@ -108,7 +117,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--schedule-cache", dest="schedule_cache", default=None,
                    help="directory for committed autotune schedules "
                         "(default profiles/schedule_cache); a second run "
-                        "with the same (model, world, comm-op, dtype) key "
+                        "with the same schedule-cache key (see "
+                        "parallel/autotune.py cache_key) "
                         "skips the race")
     p.add_argument("--no-profile-backward", action="store_true",
                    help="skip the offline backward benchmark (size prior)")
@@ -133,6 +143,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
             "num_steps", "num_batches_per_epoch", "compressor", "density",
             "comm_op", "dcn_slices", "autotune_steps", "schedule_cache",
+            "telemetry_dir",
         )
         if getattr(args, k, None) is not None
     }
@@ -140,6 +151,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         overrides["augment"] = False
     if args.tensorboard:
         overrides["tensorboard"] = True
+    if args.telemetry or args.telemetry_dir:
+        overrides["telemetry"] = True
     if args.autotune:
         overrides["autotune"] = True
     return make_config(args.dnn, **overrides)

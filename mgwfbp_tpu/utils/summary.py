@@ -22,13 +22,28 @@ from typing import Optional
 class ScalarWriter:
     """Append-only JSONL scalar event writer with optional TensorBoard
     mirroring (tensorboardX or torch.utils.tensorboard, whichever imports;
-    neither is required)."""
+    neither is required).
 
-    def __init__(self, logdir: str, filename: str = "events.jsonl"):
+    With `stream` (a telemetry `EventWriter`, telemetry/events.py), this
+    becomes a thin VIEW over the run's structured event stream: scalars
+    are emitted as typed ``scalar`` records into the same file the step
+    spans and overlap snapshots land in (one file per run), and no
+    separate events.jsonl is opened. Without it, the legacy standalone
+    JSONL layout is preserved (schema v1 of the telemetry stream —
+    `telemetry.read_events` migrates it)."""
+
+    def __init__(
+        self, logdir: str, filename: str = "events.jsonl", stream=None
+    ):
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
-        self.path = os.path.join(logdir, filename)
-        self._f = open(self.path, "a", buffering=1)  # line-buffered
+        self._stream = stream
+        self._f = None
+        if stream is None:
+            self.path = os.path.join(logdir, filename)
+            self._f = open(self.path, "a", buffering=1)  # line-buffered
+        else:
+            self.path = stream.path
         self._tb = self._make_tb_writer(logdir)
 
     @staticmethod
@@ -47,17 +62,29 @@ class ScalarWriter:
         return None
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
-        self._f.write(
-            json.dumps(
-                {
-                    "wall": round(time.time(), 3),
-                    "step": int(step),
-                    "tag": tag,
-                    "value": float(value),
-                }
+        if self._stream is not None:
+            try:
+                self._stream.emit(
+                    "scalar", tag=tag, value=float(value), step=int(step)
+                )
+            except (TypeError, ValueError):
+                raise  # schema misuse is a bug; surface it
+            except Exception:  # noqa: BLE001 — a dying stream (disk full)
+                # must not take down the training run; same contract as
+                # Trainer._emit_event, which disables its end separately
+                self._stream = None
+        elif self._f is not None:
+            self._f.write(
+                json.dumps(
+                    {
+                        "wall": round(time.time(), 3),
+                        "step": int(step),
+                        "tag": tag,
+                        "value": float(value),
+                    }
+                )
+                + "\n"
             )
-            + "\n"
-        )
         if self._tb is not None:
             self._tb.add_scalar(tag, float(value), int(step))
 
@@ -69,6 +96,7 @@ class ScalarWriter:
                 continue  # non-scalar metric (e.g. nested dict)
 
     def close(self) -> None:
+        # a shared stream is owned by its creator (the trainer), not here
         if self._f is not None and not self._f.closed:
             self._f.close()
         if self._tb is not None:

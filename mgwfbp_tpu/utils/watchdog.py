@@ -41,7 +41,13 @@ class ProgressWatchdog:
         timeout_s: Optional[float] = None,
         abort: Optional[bool] = None,
         check_interval_s: float = 10.0,
+        on_stall=None,
     ):
+        # on_stall(phase=..., idle_s=..., timeout_s=..., abort=...) is
+        # called (from the watcher thread) each time the deadline fires —
+        # the trainer hooks the telemetry stream here so stalls are
+        # greppable from the same file as the step records. It runs BEFORE
+        # a configured abort, and its own failure never masks the signal.
         env = os.environ.get("MGWFBP_WATCHDOG_S")
         self.timeout_s = (
             timeout_s
@@ -54,6 +60,7 @@ class ProgressWatchdog:
             else os.environ.get("MGWFBP_WATCHDOG_ABORT") == "1"
         )
         self.check_interval_s = check_interval_s
+        self.on_stall = on_stall
         self.log = get_logger("mgwfbp.watchdog")
         self._last = time.monotonic()
         self._phase = "startup"
@@ -96,6 +103,16 @@ class ProgressWatchdog:
                     if self.abort
                     else "",
                 )
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(
+                            phase=self._phase, idle_s=float(idle),
+                            timeout_s=float(self.timeout_s),
+                            abort=bool(self.abort),
+                        )
+                    except Exception:  # noqa: BLE001 — the stall signal
+                        # must never be masked by its own reporting
+                        self.log.exception("watchdog on_stall hook failed")
                 if self.abort:
                     # os._exit: the stalled runtime call cannot be
                     # interrupted from Python — exiting the process is the

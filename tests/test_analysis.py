@@ -113,6 +113,58 @@ def test_ast_lint_clean_module_is_clean():
     assert lint_source(src, "t.py") == []
 
 
+def test_ast_lint_jit006_telemetry_in_traced_code():
+    """JIT006: telemetry/logging emitters in a traced body run ONCE at
+    trace time instead of per step — every flavour the project uses
+    (print, logger methods, ScalarWriter, EventWriter.emit) must flag."""
+    src = (
+        "import jax\n"
+        "def step(state, batch):\n"
+        "    print('loss')\n"
+        "    log.info('iter %d', 1)\n"
+        "    self_writer = None\n"
+        "    writer.add_scalar('train/loss', 1.0, 2)\n"
+        "    telemetry.emit('step', step=1)\n"
+        "    return state\n"
+        "f = jax.jit(step)\n"
+    )
+    findings = lint_source(src, "t.py")
+    assert _ids(findings) == {"JIT006"}
+    assert len(findings) == 4
+
+
+def test_ast_lint_jit006_spares_legit_calls():
+    # jax.debug.print is a traced callback (legal, and separately policed
+    # by the jaxpr pass SCH005 in the hot path); logging OUTSIDE traced
+    # code is the normal idiom; a method named emit on a non-telemetry
+    # receiver stays clean
+    src = (
+        "import jax\n"
+        "def step(x):\n"
+        "    jax.debug.print('x={}', x)\n"
+        "    return x\n"
+        "f = jax.jit(step)\n"
+        "def untraced():\n"
+        "    print('fine')\n"
+        "    log.info('fine')\n"
+        "def traced_other(x):\n"
+        "    return sound.emit(x)\n"
+        "g = jax.jit(traced_other)\n"
+    )
+    assert lint_source(src, "t.py") == []
+
+
+def test_ast_lint_jit006_self_log_method():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    self.log.warning('hot path')\n"
+        "    return x\n"
+    )
+    assert _ids(lint_source(src, "t.py")) == {"JIT006"}
+
+
 # --------------------------------------------------------------------------
 # jaxpr verifier: clean on HEAD across the policy surface
 # --------------------------------------------------------------------------

@@ -1,0 +1,405 @@
+"""Telemetry subsystem tests: event schema round-trip + version
+migration/rejection, overlap accounting on a synthetic timeline with a
+known hidden/exposed split, Chrome-trace export validity (JSON +
+monotonic span nesting per track), trainer smoke (lenet, CPU mesh)
+producing step + group events, the elastic-resize schedule-cache consult,
+and the ZERO-SYNC guard: telemetry must not add a single device_get /
+block_until_ready to the step loop."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from mgwfbp_tpu.config import make_config
+from mgwfbp_tpu.telemetry import (
+    EVENT_SCHEMA_VERSION,
+    EventWriter,
+    attribute_overlap,
+    events_of,
+    read_events,
+)
+from mgwfbp_tpu.telemetry.export import chrome_trace, prometheus_text
+
+
+# --------------------------------------------------------------------------
+# Event schema: round trip, typing, migration, rejection
+# --------------------------------------------------------------------------
+
+
+def test_event_stream_round_trip(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    w = EventWriter(path, run={"model": "lenet", "world": 8})
+    w.emit("step", step=1, epoch=0, start_s=0.0, dur_s=0.1)
+    w.emit("checkpoint", epoch=0, iteration=1)
+    w.emit("watchdog_stall", phase="train epoch 0", idle_s=12.0,
+           timeout_s=10.0, abort=False)
+    w.emit("scalar", tag="train/loss", value=2.3, step=1)
+    w.close()
+    recs = read_events(path)
+    assert recs[0]["event"] == "header"
+    assert recs[0]["schema_version"] == EVENT_SCHEMA_VERSION
+    assert recs[0]["run"]["model"] == "lenet"
+    assert [r["event"] for r in recs[1:]] == [
+        "step", "checkpoint", "watchdog_stall", "scalar",
+    ]
+    assert all("wall" in r for r in recs)
+    # reopening appends WITHOUT a second header
+    w2 = EventWriter(path)
+    w2.emit("step", step=2, epoch=0, start_s=0.1, dur_s=0.1)
+    w2.close()
+    recs = read_events(path)
+    assert sum(1 for r in recs if r["event"] == "header") == 1
+    assert len(events_of(recs, "step")) == 2
+
+
+def test_event_writer_rejects_schema_misuse(tmp_path):
+    import jax.numpy as jnp
+
+    w = EventWriter(str(tmp_path / "t.jsonl"))
+    with pytest.raises(ValueError, match="unknown telemetry event"):
+        w.emit("no_such_event", foo=1)
+    with pytest.raises(ValueError, match="missing required"):
+        w.emit("step", step=1)  # epoch/start_s/dur_s absent
+    # a device value would force a host transfer at serialization time —
+    # the zero-sync contract requires this to fail loudly at the emit site
+    with pytest.raises(TypeError, match="zero device syncs"):
+        w.emit("scalar", tag="x", value=jnp.ones(()), step=1)
+    w.close()
+
+
+def test_legacy_scalar_stream_migrates(tmp_path):
+    """The headerless ScalarWriter JSONL (schema v1) reads back as
+    `scalar` records under a synthesized v2 header."""
+    from mgwfbp_tpu.utils.summary import ScalarWriter
+
+    sw = ScalarWriter(str(tmp_path))
+    sw.add_scalar("train/loss", 1.5, 3)
+    sw.add_scalar("train/acc", 0.5, 3)
+    sw.close()
+    recs = read_events(sw.path)
+    assert recs[0]["event"] == "header"
+    assert recs[0]["run"]["migrated_from"] == 1
+    scalars = events_of(recs, "scalar")
+    assert [s["tag"] for s in scalars] == ["train/loss", "train/acc"]
+    assert scalars[0]["value"] == 1.5 and scalars[0]["step"] == 3
+
+
+def test_unknown_schema_version_rejected(tmp_path):
+    path = str(tmp_path / "future.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "header", "schema_version": 99}) + "\n")
+        f.write(json.dumps({"event": "step", "step": 1}) + "\n")
+    with pytest.raises(ValueError, match="schema_version 99"):
+        read_events(path)
+
+
+def test_scalar_writer_is_a_view_over_the_stream(tmp_path):
+    """With a telemetry stream, ScalarWriter emits typed `scalar` records
+    into the SAME file and opens no separate events.jsonl."""
+    from mgwfbp_tpu.utils.summary import ScalarWriter
+
+    tel_path = str(tmp_path / "telemetry.jsonl")
+    w = EventWriter(tel_path)
+    sw = ScalarWriter(str(tmp_path / "scalars"), stream=w)
+    sw.add_scalar("train/loss", 2.0, 7)
+    sw.close()
+    w.close()
+    assert sw.path == tel_path
+    assert not os.path.exists(tmp_path / "scalars" / "events.jsonl")
+    recs = read_events(tel_path)
+    (s,) = events_of(recs, "scalar")
+    assert s["tag"] == "train/loss" and s["step"] == 7
+
+
+# --------------------------------------------------------------------------
+# Overlap accounting: known hidden/exposed split on a synthetic timeline
+# --------------------------------------------------------------------------
+
+
+def test_overlap_accounting_known_split():
+    # backward: three layers of 10 ms each -> ready at 10/20/30 ms,
+    # backward ends at 30 ms. Group 0 (layers 0,1) starts at 20 ms with
+    # 15 ms of comm: 10 ms hidden (20..30), 5 ms exposed. Group 1 (layer
+    # 2) is ready at 30 ms but the link frees only at 35 ms: all 10 ms
+    # exposed.
+    rows = attribute_overlap(
+        groups=[(0, 1), (2,)],
+        tb=[0.010, 0.010, 0.010],
+        comm_s=[0.015, 0.010],
+        nbytes=[100, 50],
+    )
+    g0, g1 = rows
+    assert g0.start_s == pytest.approx(0.020)
+    assert g0.hidden_s == pytest.approx(0.010)
+    assert g0.exposed_s == pytest.approx(0.005)
+    assert g1.start_s == pytest.approx(0.035)  # link busy until 35 ms
+    assert g1.hidden_s == 0.0
+    assert g1.exposed_s == pytest.approx(0.010)
+
+
+def test_overlap_accounting_fully_hidden_and_fully_exposed():
+    # tiny comm behind a long backward: fully hidden
+    (g,) = attribute_overlap([(0,)], tb=[1.0, 1.0], comm_s=[0.1],
+                             nbytes=[1])
+    assert g.hidden_s == pytest.approx(0.1) and g.exposed_s == 0.0
+    # comm for the LAST layer starts exactly at backward end: fully exposed
+    (g,) = attribute_overlap([(1,)], tb=[1.0, 1.0], comm_s=[0.5],
+                             nbytes=[1])
+    assert g.hidden_s == 0.0 and g.exposed_s == pytest.approx(0.5)
+
+
+def test_overlap_summary_efficiency_bounds():
+    from mgwfbp_tpu.telemetry.overlap import OverlapSummary
+
+    empty = OverlapSummary(step_s=0.1, tb_total_s=0.05, groups=(),
+                           attribution="cost-model")
+    assert empty.efficiency == 1.0  # comm-free step is perfectly hidden
+
+
+# --------------------------------------------------------------------------
+# Exporters: Chrome trace validity + nesting, Prometheus text
+# --------------------------------------------------------------------------
+
+
+def _synthetic_records(tmp_path):
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools"),
+    )
+    import telemetry_report
+
+    path = str(tmp_path / "synthetic.jsonl")
+    telemetry_report._synthetic_stream(path)
+    return read_events(path)
+
+
+def test_chrome_trace_exports_valid_nested_json(tmp_path):
+    from mgwfbp_tpu.telemetry.export import write_chrome_trace
+
+    records = _synthetic_records(tmp_path)
+    out = str(tmp_path / "trace.json")
+    write_chrome_trace(out, records)
+    with open(out) as f:
+        doc = json.load(f)  # must be valid JSON for chrome://tracing
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans, "no complete events exported"
+    # one track per merge group plus steps/backward/optimizer tracks
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {"steps", "backward", "optimizer"} <= names
+    assert any(n.startswith("comm group") for n in names)
+    # monotonic span nesting per track: sorted by ts, consecutive spans
+    # either follow each other or nest — never partially overlap
+    by_tid: dict = {}
+    for e in spans:
+        by_tid.setdefault(e["tid"], []).append(e)
+    eps = 1e-6
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: e["ts"])
+        for prev, nxt in zip(evs, evs[1:]):
+            assert nxt["ts"] >= prev["ts"] - eps
+            follows = nxt["ts"] >= prev["ts"] + prev["dur"] - eps
+            nests = (
+                nxt["ts"] + nxt["dur"] <= prev["ts"] + prev["dur"] + eps
+            )
+            assert follows or nests, (tid, prev, nxt)
+
+
+def test_prometheus_text_dump(tmp_path):
+    records = _synthetic_records(tmp_path)
+    text = prometheus_text(records)
+    assert "# TYPE mgwfbp_steps_total counter" in text
+    assert "mgwfbp_steps_total 24" in text
+    assert "mgwfbp_overlap_efficiency 0.4" in text
+    assert "mgwfbp_resizes_total 1" in text
+
+
+def test_report_selftest_runs():
+    import telemetry_report
+
+    assert telemetry_report.selftest() == 0
+
+
+# --------------------------------------------------------------------------
+# Trainer integration (lenet, 8-device CPU mesh)
+# --------------------------------------------------------------------------
+
+
+def _cfg(dnn="lenet", **kw):
+    base = dict(
+        lr=0.01, max_epochs=2, logdir="", checkpoint_dir=None, seed=3,
+        batch_size=8, num_batches_per_epoch=6,
+    )
+    base.update(kw)
+    return make_config(dnn, **base)
+
+
+def test_trainer_smoke_emits_step_and_group_events(tmp_path):
+    """A lenet CPU-mesh run with telemetry on produces step spans, comm
+    spans, and an overlap snapshot — the acceptance path of ISSUE 4 —
+    with scalars (tensorboard view) in the SAME stream."""
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    cfg = _cfg(logdir=str(tmp_path), telemetry=True, tensorboard=True,
+               checkpoint_dir=str(tmp_path / "ckpt"))
+    t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    t.fit(1)
+    t.close()
+    path = os.path.join(str(tmp_path), cfg.tag(), "telemetry.jsonl")
+    recs = read_events(path)
+    assert recs[0]["event"] == "header"
+    steps = events_of(recs, "step")
+    assert len(steps) == 6
+    assert all(s["dur_s"] >= 0 and s["start_s"] >= 0 for s in steps)
+    # strictly ordered spans
+    starts = [s["start_s"] for s in steps]
+    assert starts == sorted(starts)
+    groups = events_of(recs, "comm_group")
+    assert len(groups) == t.reducer.layout.num_groups
+    (ov,) = events_of(recs, "overlap")
+    assert 0.0 <= ov["efficiency"] <= 1.0
+    assert ov["attribution"] == "cost-model"  # CPU traces drop scopes
+    assert ov["comm_s"] == pytest.approx(
+        sum(g["comm_s"] for g in groups)
+    )
+    assert events_of(recs, "checkpoint")
+    assert events_of(recs, "epoch")
+    tags = {s["tag"] for s in events_of(recs, "scalar")}
+    assert "epoch/loss" in tags  # ScalarWriter view over the same stream
+    # the report CLI renders it end to end
+    import telemetry_report
+
+    report = telemetry_report.format_report(recs)
+    assert "overlap efficiency" in report
+    doc = chrome_trace(recs)
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+def test_zero_sync_guard(tmp_path, monkeypatch):
+    """Telemetry must add ZERO device syncs to the step loop: the number
+    of jax.device_get / jax.block_until_ready calls during a training
+    epoch is identical with telemetry on and off."""
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    monkeypatch.setenv("MGWFBP_LOG_INTERVAL", "1000")  # no mid-loop pulls
+
+    def run(telemetry: bool) -> int:
+        cfg = _cfg(
+            seed=5,
+            logdir=str(tmp_path / ("on" if telemetry else "off")),
+            telemetry=telemetry,
+        )
+        t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+        counts = {"n": 0}
+        real_bur = jax.block_until_ready
+        real_get = jax.device_get
+
+        def counting_bur(*a, **k):
+            counts["n"] += 1
+            return real_bur(*a, **k)
+
+        def counting_get(*a, **k):
+            counts["n"] += 1
+            return real_get(*a, **k)
+
+        with monkeypatch.context() as m:
+            m.setattr(jax, "block_until_ready", counting_bur)
+            m.setattr(jax, "device_get", counting_get)
+            t.train_epoch(0)
+        t.close()
+        return counts["n"]
+
+    assert run(telemetry=True) == run(telemetry=False)
+
+
+def test_resize_consults_schedule_cache(tmp_path):
+    """After an elastic resize, a committed autotune entry for the NEW
+    world size must win over the fresh solve — and the resize event must
+    record which path won (ISSUE 4 satellite / ROADMAP PR-3 follow-up)."""
+    from mgwfbp_tpu.parallel import autotune as at
+    from mgwfbp_tpu.train.trainer import Trainer
+
+    cache_dir = str(tmp_path / "cache")
+    cfg = _cfg(logdir=str(tmp_path), telemetry=True,
+               schedule_cache=cache_dir)
+    t = Trainer(cfg, synthetic_data=True, profile_backward=False)
+    assert t.reducer is not None
+    names = list(t.reducer.schedule.layer_names)
+    # plant a tuned single-group entry for world size 4
+    single = [list(range(len(names)))]
+    key = at.cache_key(
+        cfg.dnn, 4, cfg.comm_op, cfg.dtype, comm_dtype=cfg.comm_dtype,
+        compressor=cfg.compressor, density=cfg.density,
+        batch_size=cfg.batch_size, nsteps_update=cfg.nsteps_update,
+    )
+    at.save_cache_entry(at.entry_path(cache_dir, key), {
+        "key": key, "model": cfg.dnn, "world": 4,
+        "comm_op": cfg.comm_op, "dtype": cfg.dtype,
+        "layer_names": names, "winner": "test:single",
+        "groups": single,
+    })
+    t.update_nworker(4)
+    assert [list(g) for g in t.reducer.layout.groups] == single
+    path = os.path.join(str(tmp_path), t.config.tag(), "telemetry.jsonl")
+    (ev,) = events_of(read_events(path), "resize")
+    assert ev["schedule_source"] == "schedule-cache"
+    assert ev["old_world"] == 8 and ev["new_world"] == 4
+    # a size with NO cache entry falls back to the solver — and says so
+    t.update_nworker(2)
+    path = os.path.join(str(tmp_path), t.config.tag(), "telemetry.jsonl")
+    ev = events_of(read_events(path), "resize")[-1]
+    assert ev["schedule_source"] == "solver"
+    # training still works on the cached-then-resolved schedule
+    m = t.train_epoch(0)
+    assert np.isfinite(m["loss"])
+    t.close()
+
+
+def test_watchdog_stall_lands_in_stream(tmp_path):
+    """A watchdog stall appends a structured event (not just a CRITICAL
+    log line) via the on_stall hook."""
+    import time
+
+    from mgwfbp_tpu.utils.watchdog import ProgressWatchdog
+
+    w = EventWriter(str(tmp_path / "telemetry.jsonl"))
+
+    def on_stall(phase, idle_s, timeout_s, abort):
+        w.emit("watchdog_stall", phase=phase, idle_s=idle_s,
+               timeout_s=timeout_s, abort=abort)
+
+    with ProgressWatchdog(
+        timeout_s=0.2, check_interval_s=0.05, abort=False,
+        on_stall=on_stall,
+    ) as wd:
+        wd.beat("train epoch 0")
+        time.sleep(0.6)
+    assert wd.fired
+    w.close()
+    # the watchdog re-arms after firing so it warns periodically — one
+    # event per firing; the first carries the original stall
+    evs = events_of(read_events(w.path), "watchdog_stall")
+    assert evs
+    ev = evs[0]
+    assert ev["phase"] == "train epoch 0"
+    assert ev["idle_s"] > 0.2 and ev["abort"] is False
+
+
+def test_bench_skip_record(tmp_path, monkeypatch):
+    """bench.py's chip-unavailable path appends a bench_skip record when
+    MGWFBP_TELEMETRY_DIR is set."""
+    import bench
+
+    monkeypatch.setenv("MGWFBP_TELEMETRY_DIR", str(tmp_path))
+    bench._record_bench_skip("ChipUnavailable: no grant")
+    recs = read_events(str(tmp_path / "telemetry.jsonl"))
+    (ev,) = events_of(recs, "bench_skip")
+    assert "no grant" in ev["detail"]

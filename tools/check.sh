@@ -7,17 +7,20 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/3] ruff =="
+echo "== [1/4] ruff =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mgwfbp_tpu tests tools bench.py || rc=1
 else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/3] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
+echo "== [2/4] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
 JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
 
-echo "== [3/3] tier-1 tests =="
+echo "== [3/4] telemetry report smoke (writer -> report -> exports) =="
+JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
+
+echo "== [4/4] tier-1 tests =="
 t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
 trap 'rm -f "$t1log"' EXIT                 # must not clobber each other
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,258 @@
+"""Telemetry-stream report: overlap table + run trend from one events file.
+
+Reads a run's telemetry JSONL (written by `mgwfbp_tpu.telemetry`, enabled
+with ``--telemetry`` on the train CLI) and prints:
+
+  * the run header (model/world/comm_op/policy);
+  * the step-time trend — span count, mean/min/max seconds per step, first
+    vs last 10-span window (throughput drift over the run);
+  * the per-merge-group exposed/hidden comm table from the latest overlap
+    snapshot, with the attribution source (``trace`` on backends whose op
+    metadata keeps the `mgwfbp_groupNNNN` scopes; ``cost-model`` on the
+    CPU mesh, whose traces drop the name stack);
+  * the aggregate overlap-efficiency number (hidden / total comm — the
+    paper's headline metric);
+  * lifecycle events: resizes (and which schedule path won), checkpoints,
+    autotune race rows, watchdog stalls, bench skips.
+
+Optionally renders the same stream for external viewers:
+
+  python tools/telemetry_report.py <run>/telemetry.jsonl
+  python tools/telemetry_report.py <run>/telemetry.jsonl \
+      --chrome-trace trace.json --prometheus metrics.prom
+  python tools/telemetry_report.py --selftest   # synthetic stream smoke
+
+``--selftest`` exercises the full pipeline (writer -> reader -> report ->
+Chrome trace -> Prometheus) on a synthetic stream in a temp dir — the
+standing-gate smoke tools/check.sh runs, no accelerator or dataset needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.6g}" if isinstance(v, (int, float)) else "n/a"
+
+
+def _window_mean(spans: list[dict], sl: slice) -> float:
+    w = spans[sl]
+    return sum(float(s["dur_s"]) for s in w) / max(len(w), 1)
+
+
+def format_report(records: list[dict]) -> str:
+    from mgwfbp_tpu.telemetry import events_of
+
+    lines: list[str] = []
+    header = next(iter(events_of(records, "header")), {})
+    run = header.get("run", {}) or {}
+    desc = ", ".join(f"{k}={v}" for k, v in sorted(run.items()))
+    lines.append(
+        f"telemetry stream: schema v{header.get('schema_version', '?')}"
+        + (f" ({desc})" if desc else "")
+    )
+
+    steps = events_of(records, "step")
+    if steps:
+        durs = [float(s["dur_s"]) for s in steps]
+        lines.append("")
+        lines.append(
+            f"steps: {len(steps)} spans, mean {_fmt_s(sum(durs)/len(durs))} "
+            f"s/step (min {_fmt_s(min(durs))}, max {_fmt_s(max(durs))})"
+        )
+        if len(steps) >= 20:
+            first = _window_mean(steps, slice(0, 10))
+            last = _window_mean(steps, slice(-10, None))
+            drift = (last - first) / first * 100.0 if first > 0 else 0.0
+            lines.append(
+                f"trend: first-10 {_fmt_s(first)} s -> last-10 "
+                f"{_fmt_s(last)} s ({drift:+.1f}%)"
+            )
+    else:
+        lines.append("steps: none recorded")
+
+    from mgwfbp_tpu.telemetry.export import latest_snapshot
+
+    snap, rows = latest_snapshot(records)
+    if snap is not None:
+        lines.append("")
+        lines.append(
+            f"overlap snapshot (step {snap.get('step')}, attribution="
+            f"{snap.get('attribution')}):"
+        )
+        lines.append(
+            f"  {'group':>5} {'bytes':>12} {'comm_s':>10} {'hidden_s':>10} "
+            f"{'exposed_s':>10}"
+        )
+        for r in rows:
+            lines.append(
+                f"  {int(r['group']):>5} {int(r['nbytes']):>12} "
+                f"{_fmt_s(r['comm_s']):>10} {_fmt_s(r['hidden_s']):>10} "
+                f"{_fmt_s(r['exposed_s']):>10}"
+            )
+        lines.append(
+            f"  total comm {_fmt_s(snap.get('comm_s'))} s = hidden "
+            f"{_fmt_s(snap.get('hidden_s'))} s + exposed "
+            f"{_fmt_s(snap.get('exposed_s'))} s "
+            f"(backward {_fmt_s(snap.get('tb_total_s'))} s, step "
+            f"{_fmt_s(snap.get('step_s'))} s)"
+        )
+        lines.append(
+            f"overlap efficiency: {float(snap.get('efficiency', 0.0)):.4f} "
+            "(hidden / total comm; 1.0 = fully hidden)"
+        )
+    else:
+        lines.append("")
+        lines.append("overlap: no snapshot recorded (single-device run, "
+                     "policy 'none', or telemetry off during fit)")
+
+    lifecycle = []
+    for ev, render in (
+        ("resize", lambda r: (
+            f"resize {r.get('old_world')} -> {r.get('new_world')} "
+            f"({r.get('schedule_source')}, {r.get('num_groups')} groups)")),
+        ("checkpoint", lambda r: (
+            f"checkpoint epoch {r.get('epoch')} iter {r.get('iteration')}")),
+        ("autotune_race", lambda r: (
+            f"autotune race {r.get('label')}: "
+            f"{_fmt_s(r.get('measured_step_s'))} s/step "
+            f"({'verified' if r.get('verified') else 'rejected'})")),
+        ("autotune_commit", lambda r: (
+            f"autotune commit {r.get('winner')} "
+            f"({r.get('comm_op')}, {r.get('num_groups')} groups, "
+            f"source={r.get('source')})")),
+        ("watchdog_stall", lambda r: (
+            f"WATCHDOG STALL in {r.get('phase')!r} after "
+            f"{_fmt_s(r.get('idle_s'))} s"
+            + (" (aborted)" if r.get("abort") else ""))),
+        ("bench_skip", lambda r: f"bench skipped: {r.get('detail')}"),
+    ):
+        for r in events_of(records, ev):
+            lifecycle.append(render(r))
+    if lifecycle:
+        lines.append("")
+        lines.append("lifecycle:")
+        lines.extend(f"  {s}" for s in lifecycle)
+    return "\n".join(lines)
+
+
+def _synthetic_stream(path: str) -> None:
+    """Write a small but complete stream: header, steps, an overlap
+    snapshot with a known hidden/exposed split, and lifecycle events."""
+    from mgwfbp_tpu.telemetry import EventWriter, attribute_overlap
+
+    w = EventWriter(path, run={"model": "selftest", "world": 8})
+    tb = [0.010, 0.010, 0.010]
+    groups = [(0, 1), (2,)]
+    comm = [0.015, 0.010]
+    nbytes = [1 << 20, 1 << 19]
+    rows = attribute_overlap(groups, tb, comm, nbytes)
+    step_s = 0.045
+    for i in range(24):
+        w.emit("step", step=i, epoch=0, start_s=i * step_s, dur_s=step_s)
+    hidden = sum(r.hidden_s for r in rows)
+    total = sum(r.comm_s for r in rows)
+    w.emit(
+        "overlap", step=23, epoch=0, step_s=step_s,
+        tb_total_s=sum(tb), comm_s=total, hidden_s=hidden,
+        exposed_s=total - hidden,
+        efficiency=hidden / total, attribution="cost-model",
+        timeline_end_s=max(sum(tb), max(r.start_s + r.comm_s for r in rows)),
+    )
+    for r in rows:
+        w.emit(
+            "comm_group", step=23, group=r.group, nbytes=r.nbytes,
+            comm_s=r.comm_s, start_s=r.start_s, hidden_s=r.hidden_s,
+            exposed_s=r.exposed_s, attribution="cost-model",
+        )
+    w.emit("resize", old_world=8, new_world=4,
+           schedule_source="schedule-cache", num_groups=2)
+    w.emit("checkpoint", epoch=0, iteration=24)
+    w.close()
+
+
+def selftest() -> int:
+    """Writer -> reader -> report -> exports round trip on synthetic data."""
+    from mgwfbp_tpu.telemetry import read_events
+    from mgwfbp_tpu.telemetry.export import (
+        write_chrome_trace, write_prometheus,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_tel_selftest_") as d:
+        path = os.path.join(d, "telemetry.jsonl")
+        _synthetic_stream(path)
+        records = read_events(path)
+        report = format_report(records)
+        assert "overlap efficiency" in report, report
+        trace_path = os.path.join(d, "trace.json")
+        doc = write_chrome_trace(trace_path, records)
+        with open(trace_path) as f:
+            loaded = json.load(f)
+        assert loaded["traceEvents"] and doc["traceEvents"]
+        prom = write_prometheus(os.path.join(d, "metrics.prom"), records)
+        assert "mgwfbp_steps_total 24" in prom, prom
+        assert "mgwfbp_overlap_efficiency" in prom
+        print(report)
+        print()
+        print(
+            f"telemetry selftest OK: {len(records)} records, "
+            f"{len(loaded['traceEvents'])} trace events"
+        )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="telemetry_report",
+        description="Render a run's telemetry event stream: overlap table, "
+        "step trend, lifecycle; optional Chrome-trace/Prometheus export",
+    )
+    p.add_argument("events", nargs="?",
+                   help="telemetry JSONL path, or a run dir containing "
+                   "telemetry.jsonl")
+    p.add_argument("--chrome-trace", dest="chrome_trace", default=None,
+                   help="write a chrome://tracing / Perfetto JSON here")
+    p.add_argument("--prometheus", default=None,
+                   help="write a Prometheus text-exposition dump here")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the synthetic end-to-end smoke and exit")
+    args = p.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.events:
+        p.error("events path required (or --selftest)")
+    path = args.events
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        print(f"telemetry_report: no events file at {path}", file=sys.stderr)
+        return 2
+
+    from mgwfbp_tpu.telemetry import read_events
+
+    records = read_events(path)
+    print(format_report(records))
+    if args.chrome_trace:
+        from mgwfbp_tpu.telemetry.export import write_chrome_trace
+
+        doc = write_chrome_trace(args.chrome_trace, records)
+        print(f"chrome trace: {args.chrome_trace} "
+              f"({len(doc['traceEvents'])} events; open in Perfetto)")
+    if args.prometheus:
+        from mgwfbp_tpu.telemetry.export import write_prometheus
+
+        write_prometheus(args.prometheus, records)
+        print(f"prometheus dump: {args.prometheus}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
