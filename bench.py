@@ -73,7 +73,19 @@ def _devices_with_retry(
     """
     import jax
 
+    from mgwfbp_tpu.utils.faults import FaultPlan
     from mgwfbp_tpu.utils.platform import DeadlineExceeded, run_with_deadline
+
+    # deterministic fault injection (MGWFBP_FAULT_PLAN=chip_unavailable):
+    # exercise the structured-skip path — every retry "times out" without
+    # the real multi-minute waits, then the outage surfaces exactly like a
+    # genuinely wedged grant (bench_skip record, rc 0)
+    if FaultPlan.from_env().chip_unavailable():
+        raise ChipUnavailable(
+            f"backend init timed out after {init_timeout_s:.0f}s in each "
+            f"of {timeout_attempts} attempts — chip/tunnel unavailable "
+            "(injected by MGWFBP_FAULT_PLAN=chip_unavailable)"
+        )
 
     delays = [5.0, 15.0, 30.0]
     last = None

@@ -71,6 +71,11 @@ def main(argv=None) -> int:
                     # collective
                     norm_clip=1.0 if comm_op == "rs_opt_ag" else None,
                 ))
+        # one guard-off trace pins SCH008's other direction: disabling the
+        # non-finite guard must actually remove the finite_check eqns
+        findings.extend(verify_train_step(
+            args.model, "wfbp", grad_guard=False,
+        ))
 
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = sum(1 for f in findings if f.severity == WARNING)

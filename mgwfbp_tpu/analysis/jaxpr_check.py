@@ -23,7 +23,13 @@ asserts, against the `MergedAllreduce` that built it:
           leaking away;
   SCH005  no host callbacks / debug prints ride the hot path;
   SCH006  the step donates its input buffers (params/opt-state aliasing —
-          without it every step round-trips a full model copy through HBM).
+          without it every step round-trips a full model copy through HBM);
+  SCH008  the non-finite-gradient guard (resilience layer) is realized as
+          configured: a guard-enabled step must carry the `is_finite`
+          reduction feeding the metrics psum (its count rides the EXISTING
+          metrics_reduce collective — the guard adds no collective of its
+          own, which SCH001/SCH004 already pin), and a guard-disabled step
+          must not.
 """
 
 from __future__ import annotations
@@ -221,6 +227,7 @@ def verify_jaxpr_against_reducer(
     grad_leaves: Sequence[Any],
     *,
     expect_donation: bool = True,
+    expect_finite_guard: Optional[bool] = None,
     file: str = "<traced step>",
 ) -> list[Finding]:
     """Check the MG-WFBP invariants of a traced step against its reducer.
@@ -229,6 +236,9 @@ def verify_jaxpr_against_reducer(
     reducer: the `MergedAllreduce` the step was built with.
     grad_leaves: gradient-leaf avals in ARRIVAL order (i.e. the layout's
         leaf order — `[leaves[j] for j in reducer.perm]`).
+    expect_finite_guard: None skips the SCH008 check; True/False asserts
+        the traced program does/does not realize the non-finite-gradient
+        guard (matched via the `finite_check`-scoped `is_finite` eqns).
     """
     layout = reducer.layout
     schedule = reducer.schedule
@@ -332,6 +342,23 @@ def verify_jaxpr_against_reducer(
             add("SCH006",
                 "no donated input buffers on the jitted step "
                 "(params/opt-state copy every iteration)")
+
+    if expect_finite_guard is not None:
+        jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+        finite_eqns = [
+            e for e in iter_eqns(jaxpr)
+            if e.primitive.name == "is_finite"
+            and "finite_check" in _scope_segments(_scope_of(e))
+        ]
+        if expect_finite_guard and not finite_eqns:
+            add("SCH008",
+                "step built with the non-finite-gradient guard but the "
+                "traced program carries no finite_check-scoped is_finite "
+                "reduction — the guard silently compiled away")
+        if not expect_finite_guard and finite_eqns:
+            add("SCH008",
+                f"guard disabled but {len(finite_eqns)} finite_check-"
+                "scoped is_finite eqn(s) remain in the hot path")
     return out
 
 
@@ -364,6 +391,7 @@ def trace_train_step(
     donate: bool = True,
     batch_size: int = 16,
     norm_clip: Optional[float] = None,
+    grad_guard: bool = True,
 ) -> tuple[Any, Any, list]:
     """Build and trace a representative jitted MG-WFBP train step.
 
@@ -413,7 +441,9 @@ def trace_train_step(
         state = state.replace(
             opt_state=jax.eval_shape(reducer.optim.init)
         )
-    step = make_train_step(model, meta, tx, mesh, reducer, donate=donate)
+    step = make_train_step(
+        model, meta, tx, mesh, reducer, donate=donate, grad_guard=grad_guard,
+    )
     batch = {
         "x": jax.ShapeDtypeStruct(
             (1, batch_size) + meta.input_shape, jnp.float32
@@ -436,11 +466,16 @@ def verify_train_step(
     expect_donation: Optional[bool] = None,
     batch_size: int = 16,
     norm_clip: Optional[float] = None,
+    grad_guard: bool = True,
+    expect_finite_guard: Optional[bool] = None,
 ) -> list[Finding]:
-    """Trace one representative jitted train step and verify it."""
+    """Trace one representative jitted train step and verify it (the
+    finite guard is expected exactly as built unless overridden — the
+    override exists for the analyzer's own mutation tests)."""
     closed, reducer, arr = trace_train_step(
         model_name, policy, comm_op=comm_op, comm_dtype=comm_dtype,
         donate=donate, batch_size=batch_size, norm_clip=norm_clip,
+        grad_guard=grad_guard,
     )
     tag = f"{model_name}/{policy}" + (
         f"/{comm_op}" if comm_op != "all_reduce" else ""
@@ -448,5 +483,8 @@ def verify_train_step(
     return verify_jaxpr_against_reducer(
         closed, reducer, arr,
         expect_donation=donate if expect_donation is None else expect_donation,
+        expect_finite_guard=(
+            grad_guard if expect_finite_guard is None else expect_finite_guard
+        ),
         file=f"<train step {tag}>",
     )

@@ -91,6 +91,9 @@ _register("SCH006", ERROR,
           "state buffers not donated to the train step")
 _register("SCH007", ERROR,
           "bucket collective payload size differs from the layout's group size")
+_register("SCH008", ERROR,
+          "non-finite-gradient guard presence differs from the step's "
+          "configuration (is_finite check missing, or present when disabled)")
 
 
 _NOQA = re.compile(r"#\s*graft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s]+)\])?")

@@ -9,6 +9,19 @@ parameter re-broadcast (dist_trainer.py:32-39,66). Differences by design:
   * the epoch-boundary save the reference constructs but never executes
     (dl_trainer.py:769-777 builds the filename, no write) actually saves here.
 
+Resilience layer (ISSUE 5): checkpoints are **step-indexed** — the orbax
+step key is the global optimizer iteration, so a preempted run resumes
+from the exact step, not the last epoch boundary. Each snapshot carries
+the position needed to rebuild the data stream deterministically
+(`epoch`, `epoch_step` — the loader is a pure function of
+(seed, epoch, batch index), so position IS the iterator state) plus the
+BPTT carry for stateful models; the train-state RNG rides in the state
+itself. A sidecar ``steps_index.json`` (written atomically via
+``os.replace``) maps steps to epoch metadata so epoch-oriented consumers
+(`evaluate --all-epochs`) keep working without restoring every payload;
+directories written by the old epoch-keyed format load transparently
+(legacy mode: the orbax step IS the epoch).
+
 Checkpoint directory naming encodes the experiment config like the
 reference's log/checkpoint dirs (dl_trainer.py:771-777).
 """
@@ -16,6 +29,7 @@ reference's log/checkpoint dirs (dl_trainer.py:771-777).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 from typing import Any, Optional
 
@@ -25,66 +39,388 @@ import orbax.checkpoint as ocp
 
 from mgwfbp_tpu.train.step import TrainState
 
+INDEX_FILE = "steps_index.json"
+INDEX_VERSION = 1
+
+
+class CheckpointRestoreError(RuntimeError):
+    """A checkpoint exists but cannot be restored into the current model/
+    optimizer structure. Carries the offending leaves (shape/dtype/
+    structure diffs) instead of a raw orbax traceback, and names the
+    likely cause: config drift between the saving and restoring run."""
+
+    def __init__(self, message: str, mismatches: Optional[list[str]] = None):
+        super().__init__(message)
+        self.mismatches = list(mismatches or [])
+
 
 @dataclasses.dataclass
 class Snapshot:
     state: TrainState
     epoch: int
     iteration: int
+    # optimizer steps already completed INSIDE `epoch` when this snapshot
+    # was taken; 0 on an epoch boundary. With the deterministic loader,
+    # (epoch, epoch_step) fully names the data-iterator position.
+    epoch_step: int = 0
+    mid_epoch: bool = False
+    carry: Any = None  # BPTT hidden state (carry models), else None
 
 
 class Checkpointer:
-    """Epoch-indexed checkpoint manager over one run directory."""
+    """Step-indexed checkpoint manager over one run directory."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self._dir = os.path.abspath(directory)
+        self._max_to_keep = max_to_keep
         os.makedirs(self._dir, exist_ok=True)
+        # GC is ours, not orbax's: retention must be CLASS-aware (see
+        # _gc) — orbax's flat max_to_keep would let a burst of
+        # --ckpt-every-steps saves evict the per-epoch history that
+        # `evaluate --all-epochs` / model averaging read
         self._mgr = ocp.CheckpointManager(
             self._dir,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
-            ),
+            options=ocp.CheckpointManagerOptions(create=True),
+            # register the handler up front: a FRESH manager must be able
+            # to read item_metadata of existing steps (the proactive
+            # shape/dtype drift check) before any save taught it the type
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
+        self._index = self._load_index()
 
+    # -- sidecar index ----------------------------------------------------
+    def _index_path(self) -> str:
+        return os.path.join(self._dir, INDEX_FILE)
+
+    def _load_index(self) -> dict:
+        try:
+            with open(self._index_path()) as f:
+                idx = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        if idx.get("version") != INDEX_VERSION:
+            return {}
+        return dict(idx.get("steps", {}))
+
+    def _write_index(self) -> None:
+        # drop entries whose orbax payload was garbage-collected, then
+        # write-temp + rename so a mid-write kill never corrupts the index
+        live = {str(s) for s in self._mgr.all_steps()}
+        self._index = {k: v for k, v in self._index.items() if k in live}
+        tmp = self._index_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": INDEX_VERSION, "steps": self._index}, f)
+        os.replace(tmp, self._index_path())
+
+    # -- save -------------------------------------------------------------
     def save(self, snap: Snapshot, wait: bool = False) -> None:
+        """Atomic step-indexed save (orbax commits via tmp-dir + rename).
+
+        The orbax step key is the GLOBAL iteration. Saving a step that
+        already exists (an epoch boundary landing on a just-written
+        ``--ckpt-every-steps`` checkpoint) only updates the index metadata
+        — the state payload is identical by construction."""
+        step = int(snap.iteration)
+        entry = {
+            "epoch": int(snap.epoch),
+            "epoch_step": int(snap.epoch_step),
+            "mid_epoch": bool(snap.mid_epoch),
+            "has_carry": snap.carry is not None,
+        }
+        if step in self._mgr.all_steps():
+            prev = self._index.get(str(step), {})
+            if prev:
+                # the stored payload is immutable (identical state), so
+                # the existing entry keeps describing it — has_carry and
+                # epoch_step MUST stay (a boundary re-save over a
+                # mid-epoch save does not strip the payload's carry); an
+                # epoch-boundary re-save only PROMOTES the entry (never
+                # demote a boundary back to mid-epoch)
+                entry = dict(prev)
+                entry["epoch"] = int(snap.epoch)
+                if not snap.mid_epoch:
+                    entry["mid_epoch"] = False
+            self._index[str(step)] = entry
+            self._gc()  # a promotion changes class budgets too
+            self._write_index()
+            if wait:
+                # the payload at this step may still be an in-flight async
+                # save; an explicit durability request (preemption drain)
+                # must not be dropped just because the bytes are deduped
+                self._mgr.wait_until_finished()
+            return
         payload = {
             "state": snap.state,
-            "meta": {"epoch": snap.epoch, "iteration": snap.iteration},
+            "meta": {
+                "epoch": int(snap.epoch),
+                "iteration": int(snap.iteration),
+                "epoch_step": int(snap.epoch_step),
+                "mid_epoch": int(snap.mid_epoch),
+            },
         }
-        self._mgr.save(snap.epoch, args=ocp.args.StandardSave(payload))
+        if snap.carry is not None:
+            payload["carry"] = snap.carry
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        self._index[str(step)] = entry
+        self._gc()
+        self._write_index()
         if wait:
             self._mgr.wait_until_finished()
 
-    def latest_epoch(self) -> Optional[int]:
+    def _gc(self) -> None:
+        """Class-aware retention: keep the newest `max_to_keep`
+        epoch-BOUNDARY checkpoints AND, separately, the newest
+        `max_to_keep` mid-epoch STEP checkpoints, so frequent
+        --ckpt-every-steps saves never evict the per-epoch history."""
+        if not self._max_to_keep or self._max_to_keep <= 0:
+            return
+        bounds: list[int] = []
+        mids: list[int] = []
+        for step in sorted(self._mgr.all_steps()):
+            e = self._index.get(str(step))
+            if e is not None and e.get("mid_epoch", False):
+                mids.append(step)
+            else:
+                bounds.append(step)  # boundary, or legacy epoch-keyed
+        keep = set(bounds[-self._max_to_keep:])
+        keep |= set(mids[-self._max_to_keep:])
+        for step in bounds + mids:
+            if step not in keep:
+                self._mgr.delete(step)
+
+    # -- listing ----------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def all_epochs(self) -> list[int]:
-        return sorted(self._mgr.all_steps())
+    def _epoch_boundaries(self) -> dict[int, int]:
+        """{epoch: step} for every epoch-boundary snapshot. Orbax steps
+        absent from the index are legacy epoch-keyed saves (step == epoch)."""
+        out: dict[int, int] = {}
+        for step in sorted(self._mgr.all_steps()):
+            entry = self._index.get(str(step))
+            if entry is None:  # legacy format
+                out[int(step)] = int(step)
+            elif not entry.get("mid_epoch", False):
+                out[int(entry["epoch"])] = int(step)
+        return out
 
+    def latest_epoch(self) -> Optional[int]:
+        bounds = self._epoch_boundaries()
+        return max(bounds) if bounds else None
+
+    def all_epochs(self) -> list[int]:
+        return sorted(self._epoch_boundaries())
+
+    # -- restore ----------------------------------------------------------
     def restore(
-        self, target_state: TrainState, epoch: Optional[int] = None
+        self,
+        target_state: TrainState,
+        epoch: Optional[int] = None,
+        step: Optional[int] = None,
+        carry_template: Any = None,
     ) -> Optional[Snapshot]:
         """Restore into the structure of `target_state` (shapes/dtypes must
         match the current model/optimizer — the reference has the same
-        contract via load_state_dict)."""
-        step = epoch if epoch is not None else self._mgr.latest_step()
+        contract via load_state_dict). `epoch` selects that epoch's
+        boundary snapshot, `step` an exact iteration; default is the
+        latest snapshot of any kind. Structure/shape/dtype mismatches
+        raise `CheckpointRestoreError` naming the offending leaves."""
         if step is None:
+            if epoch is not None:
+                step = self._epoch_boundaries().get(int(epoch))
+            else:
+                step = self._mgr.latest_step()
+        if step is None or step not in self._mgr.all_steps():
             return None
+        entry = self._index.get(str(step))
+        healed = False
+        if entry is None:
+            # no index entry: either a genuine legacy epoch-keyed payload,
+            # or a NEW-format step whose sidecar write was killed between
+            # the orbax commit and os.replace (the preemption grace period
+            # expiring mid-drain). Probe the stored metadata — misreading
+            # a new payload as legacy would turn a mid-epoch snapshot into
+            # an epoch boundary and silently skip the rest of the epoch.
+            entry = self._probe_format(int(step))
+            healed = entry is not None
+        if entry is None:
+            return self._restore_legacy(target_state, int(step))
+        template: dict[str, Any] = {
+            "state": target_state,
+            "meta": {
+                "epoch": 0, "iteration": 0, "epoch_step": 0, "mid_epoch": 0,
+            },
+        }
+        if entry.get("has_carry", False):
+            if carry_template is None:
+                raise CheckpointRestoreError(
+                    f"checkpoint step {step} in {self._dir!r} carries a "
+                    "model carry (BPTT hidden state) but no carry template "
+                    "was supplied — restore through a trainer built for "
+                    "the same stateful model"
+                )
+            template["carry"] = carry_template
+        restored = self._restore_checked(int(step), template)
+        meta = restored["meta"]
+        if healed:
+            # repair the sidecar from the payload's own bookkeeping so the
+            # next open doesn't have to probe again
+            self._index[str(step)] = {
+                "epoch": int(meta["epoch"]),
+                "epoch_step": int(meta["epoch_step"]),
+                "mid_epoch": bool(int(meta["mid_epoch"])),
+                "has_carry": "carry" in restored,
+            }
+            self._write_index()
+            entry = self._index[str(step)]
+        # the INDEX is authoritative for epoch/mid_epoch: a boundary save
+        # deduped onto an earlier mid-epoch payload promotes the entry
+        # while the payload's meta still says mid_epoch — trusting the
+        # payload would make the promoted boundary resume as mid-epoch
+        mid_epoch = bool(entry.get("mid_epoch", int(meta["mid_epoch"])))
+        return Snapshot(
+            state=restored["state"],
+            epoch=int(entry.get("epoch", meta["epoch"])),
+            iteration=int(meta["iteration"]),
+            epoch_step=int(meta["epoch_step"]),
+            mid_epoch=mid_epoch,
+            carry=restored.get("carry"),
+        )
+
+    def _probe_format(self, step: int) -> Optional[dict]:
+        """Minimal index entry inferred from stored metadata for an
+        UNINDEXED step, or None when the payload really is the legacy
+        epoch-keyed format (2-key meta, no epoch_step)."""
+        try:
+            md = self._mgr.item_metadata(step)
+        except Exception:  # noqa: BLE001 — undecidable: treat as legacy
+            return None
+        if not isinstance(md, dict) or not isinstance(md.get("meta"), dict):
+            return None
+        if "epoch_step" not in md["meta"]:
+            return None
+        return {"has_carry": "carry" in md}
+
+    def _restore_legacy(
+        self, target_state: TrainState, step: int
+    ) -> Snapshot:
+        """Epoch-keyed payloads from the pre-resilience format: the orbax
+        step is the epoch, meta has only {'epoch','iteration'}."""
         template = {
             "state": target_state,
             "meta": {"epoch": 0, "iteration": 0},
         }
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(template)
-        )
+        restored = self._restore_checked(step, template)
         return Snapshot(
             state=restored["state"],
             epoch=int(restored["meta"]["epoch"]),
             iteration=int(restored["meta"]["iteration"]),
         )
 
+    def _restore_checked(self, step: int, template: Any) -> Any:
+        # proactive shape/dtype validation: orbax's StandardRestore does
+        # NOT fail on a mismatched template — it hands back the saved
+        # shapes, deferring the blow-up to the first jitted dispatch with
+        # an inscrutable shape error. Diff the stored metadata against the
+        # template FIRST and fail here, naming the drifted leaves.
+        mismatches = self._template_diff(step, template)
+        if mismatches:
+            raise CheckpointRestoreError(
+                self._drift_message(step, mismatches), mismatches=mismatches
+            )
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        except CheckpointRestoreError:
+            raise
+        except Exception as e:  # noqa: BLE001 — rewrapped with context
+            raise CheckpointRestoreError(
+                self._drift_message(step, []) + f" (orbax: {e})"
+            ) from e
+
+    def _drift_message(self, step: int, mismatches: list[str]) -> str:
+        detail = (
+            "; offending leaves:\n  " + "\n  ".join(mismatches[:20])
+            if mismatches
+            else ""
+        )
+        return (
+            f"cannot restore checkpoint step {step} from {self._dir!r} "
+            "into the current model/optimizer structure — likely config "
+            "drift (the checkpoint was saved under a different --dnn / "
+            f"optimizer / precision configuration){detail}"
+        )
+
+    def _template_diff(self, step: int, template: Any) -> list[str]:
+        """Human-readable (path: saved vs expected) diffs between the
+        stored payload's metadata and the restore template — best effort;
+        metadata unavailable degrades to the wrapped orbax message."""
+        try:
+            saved_md = self._mgr.item_metadata(step)
+            saved = {
+                _path_str(kp): v
+                for kp, v in jax.tree_util.tree_flatten_with_path(saved_md)[0]
+            }
+            want = {
+                _path_str(kp): v
+                for kp, v in jax.tree_util.tree_flatten_with_path(
+                    jax.eval_shape(lambda: template)
+                )[0]
+            }
+        except Exception:  # noqa: BLE001 — diffing is best-effort
+            return []
+        if not saved or not any(
+            hasattr(v, "shape") for v in saved.values()
+        ):
+            # metadata unavailable/uninterpretable: no diff evidence —
+            # let the actual restore decide instead of crying drift
+            return []
+        out = []
+        for path in sorted(set(saved) | set(want)):
+            if path.startswith("meta."):
+                continue  # bookkeeping ints; never the drifted leaves
+            s, w = saved.get(path), want.get(path)
+            if s is None:
+                out.append(f"{path}: missing in checkpoint (expected "
+                           f"{_leaf_desc(w)})")
+            elif w is None:
+                out.append(f"{path}: present in checkpoint "
+                           f"({_leaf_desc(s)}) but not in the current "
+                           "structure")
+            elif _leaf_desc(s) != _leaf_desc(w):
+                out.append(f"{path}: checkpoint has {_leaf_desc(s)}, "
+                           f"current structure wants {_leaf_desc(w)}")
+        return out
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def _path_str(kp) -> str:
+    """Canonical dotted path for a tree_flatten_with_path key path.
+
+    Orbax metadata comes back as plain nested dicts while the restore
+    template carries dataclass pytrees (TrainState), so DictKey vs
+    GetAttrKey must compare equal for the same logical leaf."""
+    names = []
+    for entry in kp:
+        name = getattr(entry, "key", None)
+        if name is None:
+            name = getattr(entry, "name", None)
+        if name is None:
+            name = getattr(entry, "idx", None)
+        names.append(str(name))
+    return ".".join(names)
+
+
+def _leaf_desc(leaf: Any) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None and dtype is None:
+        return type(leaf).__name__
+    return f"{np.dtype(dtype).name if dtype is not None else '?'}" \
+           f"{tuple(shape) if shape is not None else ''}"

@@ -89,6 +89,14 @@ class TrainConfig:
     telemetry_dir: Optional[str] = None  # events dir; default <logdir>/<tag>
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 1
+    # resilience layer (ISSUE 5)
+    ckpt_every_steps: int = 0  # mid-epoch step-indexed checkpoints every N
+    # optimizer steps (0 = epoch boundaries only); a SIGTERM/SIGINT drain
+    # always writes one regardless, so preemption loses at most one step
+    grad_guard: bool = True  # non-finite-gradient guard in the jitted step:
+    # drop the update on NaN/inf grads (bad_step telemetry, zero host syncs)
+    bad_step_limit: int = 3  # consecutive bad steps before rolling back to
+    # the last checkpoint (0 disables rollback; skipping still applies)
     pretrain: Optional[str] = None
     seed: int = 0
     num_batches_per_epoch: Optional[int] = None

@@ -35,8 +35,16 @@ def _install_and_eval(trainer, state) -> dict:
     return trainer.evaluate()
 
 
-def _restore_or_raise(ckpt, root: str, template, epoch: Optional[int]):
-    snap = ckpt.restore(template, epoch=epoch)
+def _restore_or_raise(
+    ckpt, root: str, template, epoch: Optional[int], carry_template=None
+):
+    if epoch is None:
+        # prefer the newest epoch BOUNDARY: with --ckpt-every-steps the
+        # raw latest snapshot may be mid-epoch, and evaluation semantics
+        # are per-epoch; fall back to the latest of any kind for dirs
+        # holding only step checkpoints
+        epoch = ckpt.latest_epoch()
+    snap = ckpt.restore(template, epoch=epoch, carry_template=carry_template)
     if snap is None:
         raise FileNotFoundError(
             f"no checkpoint under {root!r}"
@@ -64,7 +72,10 @@ def _eval_snapshots(
     try:
         epochs = pick_epochs(ckpt)
         for e in epochs:
-            snap = _restore_or_raise(ckpt, checkpoint_root, trainer.state, e)
+            snap = _restore_or_raise(
+                ckpt, checkpoint_root, trainer.state, e,
+                carry_template=trainer._carry_template(),
+            )
             metrics = _install_and_eval(trainer, snap.state)
             metrics["epoch"] = snap.epoch
             yield metrics
@@ -145,7 +156,10 @@ def model_average_evaluate(
             ckpt = Checkpointer(root)
             try:
                 snaps.append(
-                    _restore_or_raise(ckpt, root, trainer.state, epoch)
+                    _restore_or_raise(
+                        ckpt, root, trainer.state, epoch,
+                        carry_template=trainer._carry_template(),
+                    )
                 )
             finally:
                 ckpt.close()

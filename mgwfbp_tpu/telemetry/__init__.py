@@ -13,6 +13,7 @@ from mgwfbp_tpu.telemetry.events import (
     EVENT_TYPES,
     EventWriter,
     events_of,
+    read_event_set,
     read_events,
 )
 from mgwfbp_tpu.telemetry.overlap import (
@@ -28,6 +29,7 @@ __all__ = [
     "EVENT_TYPES",
     "EventWriter",
     "events_of",
+    "read_event_set",
     "read_events",
     "GroupOverlap",
     "OverlapSummary",

@@ -72,12 +72,26 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # elastic resize seam; schedule_source records which path won the
     # post-resize schedule ("schedule-cache" vs "solver")
     "resize": ("old_world", "new_world", "schedule_source", "num_groups"),
-    "checkpoint": ("epoch", "iteration"),
+    # a written snapshot; mid_epoch=True rows (the --ckpt-every-steps /
+    # preemption-drain path) additionally carry epoch_step
+    "checkpoint": ("epoch", "iteration", "mid_epoch"),
     # watchdog stall/abort (also CRITICAL-logged; this makes it greppable
     # from the same file as the step records)
     "watchdog_stall": ("phase", "idle_s", "timeout_s", "abort"),
     # bench.py structured skip (chip unavailable)
     "bench_skip": ("detail",),
+    # --- resilience layer (ISSUE 5) ------------------------------------
+    # graceful preemption drain: the in-flight step finished, a
+    # step-indexed checkpoint was written, the process exits rc 75
+    "preempt": ("signal", "epoch", "iteration"),
+    # non-finite-gradient guard: the jitted step dropped this update
+    # (nonfinite = global count of non-finite gradient elements)
+    "bad_step": ("step", "epoch", "nonfinite"),
+    # K consecutive bad steps -> trainer rolled back to the last checkpoint
+    "rollback": ("bad_steps", "restored_iteration", "restored_epoch"),
+    # a restart picked up from a saved snapshot (mid_epoch = step-indexed
+    # mid-epoch checkpoint, i.e. the preemption-safe resume path)
+    "resume": ("epoch", "iteration", "mid_epoch"),
 }
 
 _JSON_SCALARS = (str, int, float, bool, type(None))
@@ -106,6 +120,40 @@ def _check_jsonable(value, key: str) -> None:
     )
 
 
+def _rotated_segments(path: str) -> list[str]:
+    """Rotated sibling files of an active stream, oldest first.
+
+    Rotation renames the active file to ``<path>.NNNN`` (zero-padded
+    sequence); sort by that integer, NOT lexically, so segment 10 follows
+    9 even if a hand-rotated unpadded name slipped in."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith(base + "."):
+            continue
+        suffix = name[len(base) + 1:]
+        if suffix.isdigit():
+            out.append((int(suffix), os.path.join(d, name)))
+    return [p for _, p in sorted(out)]
+
+
+def _next_segment_index(path: str) -> int:
+    """Index the ACTIVE stream at `path` will rotate into next: one past
+    the highest existing segment — NOT the segment count, which would
+    re-use (and os.replace would silently clobber) the newest surviving
+    segment after an operator deletes old ones to reclaim disk."""
+    segs = _rotated_segments(path)
+    if not segs:
+        return 0
+    last = os.path.basename(segs[-1])
+    return int(last.rsplit(".", 1)[1]) + 1
+
+
 class EventWriter:
     """Append-only JSONL event stream (one run, process 0).
 
@@ -113,37 +161,62 @@ class EventWriter:
     empty) file; re-opening an existing stream appends without a second
     header. Thread-safe for concurrent emitters (the watchdog fires from
     its daemon thread) — each record is one line-buffered write.
+
+    Week-long jobs rotate by size (ROADMAP PR-4 follow-up): when the
+    active file exceeds ``max_bytes`` (default from
+    ``MGWFBP_TELEMETRY_MAX_MB``; unset/0 = never rotate) it is renamed to
+    ``<path>.NNNN`` and a fresh segment opens. Every segment starts with
+    its own header carrying the SET's original wall anchor and a
+    ``segment`` index, so `read_event_set` reassembles one continuous
+    timeline and a restart re-anchors correctly off the active segment.
     """
 
-    def __init__(self, path: str, run: Optional[dict] = None):
+    def __init__(
+        self,
+        path: str,
+        run: Optional[dict] = None,
+        max_bytes: Optional[int] = None,
+    ):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if max_bytes is None:
+            mb = os.environ.get("MGWFBP_TELEMETRY_MAX_MB", "").strip()
+            max_bytes = int(float(mb) * 1024 * 1024) if mb else 0
+        self.max_bytes = max(int(max_bytes), 0)
+        self._run = dict(run or {})
+        self._segment = _next_segment_index(path)
         fresh = not (os.path.exists(path) and os.path.getsize(path) > 0)
         header_wall = None
         if not fresh:
             # re-opening (resume under the same tag): span timestamps stay
             # relative to the ORIGINAL header's wall clock, so appended
             # records extend the stream's timeline instead of restarting
-            # at zero on top of the first run's spans
+            # at zero on top of the first run's spans (rotation headers
+            # re-stamp that original anchor into every segment)
             try:
                 with open(path) as f:
                     first = json.loads(f.readline())
                 if first.get("event") == "header":
                     header_wall = float(first.get("wall", 0.0)) or None
+                    self._run = dict(first.get("run", self._run) or {})
             except (OSError, ValueError):
                 header_wall = None
         self._f = open(path, "a", buffering=1)  # line-buffered
+        self._bytes = 0 if fresh else os.path.getsize(path)
         self._lock = threading.Lock()
         # stream-relative clock for span timestamps: monotonic, immune to
         # wall-clock steps mid-run; anchored at the stream header's wall
         self._t0 = time.perf_counter()
+        self._anchor_wall = header_wall if header_wall else time.time()
         if header_wall is not None:
             self._t0 -= max(time.time() - header_wall, 0.0)
         if fresh:
-            self.emit(
+            self._emit_record(
                 "header",
+                wall=self._anchor_wall,
                 schema_version=EVENT_SCHEMA_VERSION,
-                run=dict(run or {}),
+                run=self._run,
+                segment=self._segment,
             )
 
     def now(self) -> float:
@@ -168,11 +241,52 @@ class EventWriter:
             )
         for k, v in fields.items():
             _check_jsonable(v, k)
-        rec = {"event": event, "wall": round(time.time(), 3), **fields}
+        self._emit_record(event, wall=time.time(), **fields)
+
+    def _emit_record(self, event: str, wall: float, **fields) -> None:
+        rec = {"event": event, "wall": round(wall, 3), **fields}
         line = json.dumps(rec) + "\n"
         with self._lock:
-            if not self._f.closed:
-                self._f.write(line)
+            if self._f.closed:
+                return
+            self._f.write(line)
+            self._bytes += len(line)
+            if (
+                self.max_bytes
+                and self._bytes > self.max_bytes
+                and event != "header"
+            ):
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Roll the active file to the next ``<path>.NNNN`` segment and
+        start a fresh one (caller holds the lock). A failed rename (e.g.
+        read-only sibling dir entries) disables rotation rather than
+        killing the run — same contract as every other telemetry failure."""
+        self._f.close()
+        target = f"{self.path}.{self._segment:04d}"
+        try:
+            os.replace(self.path, target)
+        except OSError:
+            self.max_bytes = 0  # rotation unavailable; keep appending
+            self._f = open(self.path, "a", buffering=1)
+            return
+        self._segment += 1
+        self._f = open(self.path, "a", buffering=1)
+        self._bytes = 0
+        # segment header: SAME schema + run + original wall anchor, so a
+        # restart re-anchoring off this segment (and any reader of it in
+        # isolation) sees the set's single continuous timeline
+        rec = {
+            "event": "header",
+            "wall": round(self._anchor_wall, 3),
+            "schema_version": EVENT_SCHEMA_VERSION,
+            "run": self._run,
+            "segment": self._segment,
+        }
+        line = json.dumps(rec) + "\n"
+        self._f.write(line)
+        self._bytes += len(line)
 
     def close(self) -> None:
         with self._lock:
@@ -229,6 +343,28 @@ def read_events(path: str) -> list[dict]:
         "run": {"migrated_from": _LEGACY_SCALAR_VERSION},
     }
     return [header] + migrated
+
+
+def read_event_set(path: str) -> list[dict]:
+    """Load a possibly-rotated stream: every ``<path>.NNNN`` segment in
+    sequence order, then the active file. Each segment is schema-validated
+    by `read_events`; the first header is kept and the per-segment
+    continuation headers dropped, so consumers see ONE stream exactly as
+    if rotation had never happened. A bare un-rotated file reads
+    identically to `read_events`."""
+    parts = _rotated_segments(path)
+    if os.path.exists(path):
+        parts = parts + [path]
+    if not parts:
+        raise FileNotFoundError(path)
+    out: list[dict] = []
+    for p in parts:
+        rows = read_events(p)
+        for r in rows:
+            if r.get("event") == "header" and out:
+                continue  # continuation header of a later segment
+            out.append(r)
+    return out
 
 
 def events_of(records: list[dict], *names: str) -> list[dict]:

@@ -93,6 +93,20 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def _nonfinite_count(tree: Any) -> jax.Array:
+    """Count of non-finite elements over the floating leaves of a gradient
+    pytree, as a float32 scalar (it rides the metrics pmean, whose leaves
+    are floats)."""
+    counts = [
+        jnp.sum(~jnp.isfinite(leaf))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(leaf.dtype, jnp.floating)
+    ]
+    if not counts:
+        return jnp.zeros((), jnp.float32)
+    return sum(counts).astype(jnp.float32)
+
+
 def make_loss_fn(
     model: Any,
     meta: ModelMeta,
@@ -231,8 +245,23 @@ def make_train_step(
     seq_axis: Optional[str] = None,
     compute_dtype: Optional[Any] = None,
     donate: bool = True,
+    grad_guard: bool = True,
 ) -> Callable:
     """Build the jitted sharded train step.
+
+    grad_guard: the non-finite-gradient guard (resilience layer, ISSUE 5).
+    The step counts non-finite elements of the (post-allreduce) gradients
+    — `metrics["grads_nonfinite"]`, riding the EXISTING metrics pmean so
+    no collective and no host sync is added — and, when the global count
+    is non-zero, DROPS the update: params/opt-state/batch-stats/carry and
+    the step counter all keep their pre-step values (a skipped step never
+    happened, exactly like a loss-scaler skip). The trainer reads the
+    metric asynchronously to emit `bad_step` events and to trigger
+    rollback after K consecutive bad steps. On the rs_opt_ag path the
+    reduced gradients never materialize, so the count is taken on the
+    LOCAL pre-reduction gradients — NaN/inf propagate through the
+    reduce-scatter, so the psum'd count is non-zero iff the shard update
+    consumed non-finite data.
 
     compute_dtype: mixed-precision forward/backward dtype (see
     make_loss_fn) — master params, optimizer math, and collectives stay
@@ -362,16 +391,26 @@ def make_train_step(
         # "flat_grad_reduce"); the metrics/BN-stats pmeans are declared
         # auxiliary so the verifier can tell them from hot-path strays.
         if sharded_opt:
+            if grad_guard:
+                # reduced grads never materialize on this path; count the
+                # local grads — non-finites survive the reduce-scatter, so
+                # the pmean'd count is the same zero/non-zero signal
+                with jax.named_scope("finite_check"):
+                    metrics["grads_nonfinite"] = _nonfinite_count(grads)
             # rs_opt_ag: reduction and optimizer are one fused phase —
             # params come back already updated, tx.update never runs
             new_params, new_opt_state = reducer.reduce_and_update(
                 grads, state.params, state.opt_state
             )
-        elif reducer is not None:
-            grads = reducer(grads)
         else:
-            with jax.named_scope("flat_grad_reduce"):
-                grads = lax.pmean(grads, red_axes)
+            if reducer is not None:
+                grads = reducer(grads)
+            else:
+                with jax.named_scope("flat_grad_reduce"):
+                    grads = lax.pmean(grads, red_axes)
+            if grad_guard:
+                with jax.named_scope("finite_check"):
+                    metrics["grads_nonfinite"] = _nonfinite_count(grads)
         with jax.named_scope("metrics_reduce"):
             metrics = lax.pmean(metrics, red_axes)
         # BN running stats: keep replicas identical (the reference leaves
@@ -391,6 +430,22 @@ def make_train_step(
             batch_stats=bstats,
             opt_state=new_opt_state,
         )
+        if grad_guard:
+            # skip-step policy: the post-pmean count is replica-identical,
+            # so every device takes the same branch — a bad step keeps the
+            # ENTIRE pre-step state (params, opt state, batch stats, step
+            # counter, carry), as if the step never ran
+            with jax.named_scope("bad_step_guard"):
+                ok = metrics["grads_nonfinite"] == 0.0
+                new_state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    new_state, state,
+                )
+                if new_carry is not None:
+                    new_carry = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(ok, new, old),
+                        new_carry, carry,
+                    )
         return new_state, metrics, new_carry
 
     # P treats a one-element tuple of axis names like the bare name

@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal as _signal
+import threading
 import time
+from collections import deque
 from typing import Any, Optional
 
 import jax
@@ -42,7 +45,35 @@ from mgwfbp_tpu.train.step import (
     make_eval_step,
     make_train_step,
 )
+from mgwfbp_tpu.utils.faults import FaultPlan, Preempted
 from mgwfbp_tpu.utils.logging import get_logger
+
+
+class _RollbackRequested(Exception):
+    """Internal: K consecutive non-finite steps — unwind train_epoch so
+    _fit_epochs can restore the last checkpoint and continue from there."""
+
+    def __init__(self, bad_steps: int):
+        super().__init__(f"{bad_steps} consecutive non-finite steps")
+        self.bad_steps = bad_steps
+
+
+def _poison_batch(batch: Any) -> tuple[Any, bool]:
+    """NaN-fill every floating leaf of a stacked batch (fault injection:
+    NaN inputs make every post-allreduce gradient non-finite without
+    touching the compiled step). Returns (batch, poisoned?) — an all-int
+    batch (token LMs) has nothing to poison."""
+    poisoned = False
+
+    def fill(v):
+        nonlocal poisoned
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            poisoned = True
+            return jnp.full_like(v, jnp.nan)
+        return v
+
+    out = jax.tree_util.tree_map(fill, batch)
+    return (out if poisoned else batch), poisoned
 
 
 class Trainer:
@@ -190,6 +221,32 @@ class Trainer:
         self.iteration = 0
         self.carry = None
         self.autotune_report = None  # set by autotune() (cache hit or race)
+        # resilience layer (ISSUE 5): deterministic fault plan, graceful
+        # preemption drain, non-finite-step bookkeeping, mid-epoch resume
+        self._faults = FaultPlan.from_env()
+        if self._faults:
+            self.log.info("fault plan armed: %s", self._faults.describe())
+        self._preempt_signal: Optional[str] = None
+        self._signals_armed = False
+        self._resume_epoch: Optional[int] = None  # mid-epoch resume target
+        self._resume_skip_steps = 0  # optimizer steps already done there
+        self._resume_carry = None
+        self._bad_streak = 0  # consecutive non-finite steps observed
+        # guard flags are read LATE (deque), so checking them never stalls
+        # the dispatch pipeline and adds no device_get/block_until_ready.
+        # Cadence: every step by default; through a tunneled chip each
+        # scalar pull costs an RTT, so MGWFBP_GUARD_CHECK_INTERVAL=N
+        # batches N steps' flags into ONE stacked pull (detection lags by
+        # at most N steps; the in-jit skip protects the params either way)
+        self._pending_guard: deque = deque()
+        self._guard_interval = max(
+            int(os.environ.get("MGWFBP_GUARD_CHECK_INTERVAL", "1")), 1
+        )
+        # rollback livelock detection: a second rollback with NO finite
+        # step observed since the first means the NaN source is
+        # deterministic — abort instead of looping
+        self._last_rollback_iteration: Optional[int] = None
+        self._good_step_since_rollback = True
         self._maybe_resume()
 
     # ------------------------------------------------------------------
@@ -298,6 +355,7 @@ class Trainer:
             nsteps_update=self.config.nsteps_update,
             axis_name=self.data_axes, seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
+            grad_guard=self.config.grad_guard,
         )
         self.eval_step = make_eval_step(
             step_model, self.meta, self.mesh, axis_name=self.data_axes,
@@ -1104,6 +1162,7 @@ class Trainer:
         tag = self.reducer.schedule.policy_detail or self.config.policy
         return verify_jaxpr_against_reducer(
             closed, self.reducer, arr, expect_donation=True,
+            expect_finite_guard=self.config.grad_guard,
             file=f"<live step {tag}>",
         )
 
@@ -1586,19 +1645,64 @@ class Trainer:
         # profiles/host_sync_tpu.json), so long runs raise the interval
         log_interval = int(os.environ.get("MGWFBP_LOG_INTERVAL", "10"))
         metrics: dict = {}
+        # mid-epoch resume (preemption / rollback): (epoch, epoch_step)
+        # fully names the deterministic loader's position, so skipping the
+        # first epoch_step * nsteps_update micro-batches replays the run
+        # bit-for-bit from the checkpointed step
+        skip_micro = 0
+        epoch_pos = 0  # optimizer-step position within the epoch
+        resume_carry = None
+        if self._resume_epoch is not None and epoch == self._resume_epoch:
+            skip_micro = self._resume_skip_steps * nsteps
+            epoch_pos = self._resume_skip_steps
+            resume_carry = self._resume_carry
+            self.log.info(
+                "epoch %d: resuming mid-epoch at step %d (skipping %d "
+                "micro-batch(es))", epoch, epoch_pos, skip_micro,
+            )
+        self._resume_epoch = None
+        self._resume_skip_steps = 0
+        self._resume_carry = None
         if self.meta.has_carry:
-            # fresh hidden state each epoch (reference init_hidden per epoch)
+            # fresh hidden state each epoch (reference init_hidden per
+            # epoch) — unless a mid-epoch checkpoint carried one
             self.carry = self._globalize(
-                self.model.initial_carry(self.process_batch), axes=0
+                resume_carry
+                if resume_carry is not None
+                else self.model.initial_carry(self.process_batch),
+                axes=0,
             )
         wd = getattr(self, "_watchdog", None)
         wd_phase = f"train epoch {epoch}"
         for raw in loader:
+            if skip_micro > 0:
+                skip_micro -= 1
+                continue
             micro.append(self._to_model_batch(raw))
             if len(micro) < nsteps:
                 continue
             batch = self._stack_micro(micro)
             micro = []
+            stall_s = self._faults.stall_secs("train", self.iteration + 1)
+            if stall_s > 0:
+                self.log.warning(
+                    "fault injection: stalling %.3g s before step %d",
+                    stall_s, self.iteration + 1,
+                )
+                time.sleep(stall_s)
+            if self._faults.nan_at(self.iteration + 1):
+                batch, poisoned = _poison_batch(batch)
+                if poisoned:
+                    self.log.warning(
+                        "fault injection: NaN batch for step %d",
+                        self.iteration + 1,
+                    )
+                else:
+                    self.log.warning(
+                        "fault injection: nan@step=%d requested but the "
+                        "batch has no floating leaves to poison",
+                        self.iteration + 1,
+                    )
             if wd is not None and not self._train_step_compiled:
                 # the first dispatch traces+compiles the step program — a
                 # legitimately long silent phase the per-step timeout must
@@ -1624,6 +1728,7 @@ class Trainer:
             if wd is not None:
                 wd.beat(wd_phase)
             self.iteration += 1
+            epoch_pos += 1
             if self.telemetry is not None:
                 self._emit_event(
                     "step", step=int(self.iteration), epoch=int(epoch),
@@ -1632,22 +1737,48 @@ class Trainer:
                 )
             window_iters += 1
             epoch_steps += 1
-            if max_steps is not None and epoch_steps >= max_steps:
+            # non-finite guard bookkeeping (one step LATE via the deque, so
+            # the dispatch pipeline never stalls); may raise
+            # _RollbackRequested after bad_step_limit consecutive bad steps
+            self._note_guard_flag(epoch, metrics)
+            if (
+                cfg.ckpt_every_steps
+                and self.checkpointer is not None
+                and epoch_pos % cfg.ckpt_every_steps == 0
+            ):
+                if wd is not None:
+                    from mgwfbp_tpu.utils.watchdog import CHECKPOINT_ALLOW_S
+
+                    wd.beat(f"step checkpoint iter {self.iteration}",
+                            allow_s=CHECKPOINT_ALLOW_S)
+                self.save_step(epoch, epoch_pos)
+                if wd is not None:
+                    wd.beat(wd_phase)
+            sig = self._faults.preempt_signal_after(self.iteration)
+            if sig is not None:
+                self._deliver_preempt(sig)
+            if self._preempt_signal is not None:
+                self._graceful_drain(epoch, epoch_pos)  # raises Preempted
+            if max_steps is not None and epoch_pos >= max_steps:
                 break
             if self.iteration % log_interval == 0:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 dt = (time.time() - t_window) / max(window_iters, 1)
                 global_batch = cfg.batch_size * self.data_size * nsteps
+                shown = {
+                    k: v for k, v in metrics.items()
+                    if k not in ("loss", "grads_nonfinite")
+                }
                 self.log.info(
                     "epoch %d iter %d: loss %.4f%s | %.4f s/iter, %.1f samples/s",
                     epoch, self.iteration, metrics.get("loss", float("nan")),
-                    "".join(
-                        f", {k} {v:.4f}" for k, v in metrics.items() if k != "loss"
-                    ),
+                    "".join(f", {k} {v:.4f}" for k, v in shown.items()),
                     dt, global_batch / dt,
                 )
                 if self.writer is not None:
-                    self.writer.add_scalars("train", metrics, self.iteration)
+                    self.writer.add_scalars("train", shown | {
+                        "loss": metrics.get("loss", float("nan")),
+                    }, self.iteration)
                     self.writer.add_scalar(
                         "train/sec_per_iter", dt, self.iteration
                     )
@@ -1665,6 +1796,10 @@ class Trainer:
                 "(loader length %% nsteps_update=%d != 0)",
                 epoch, len(micro), nsteps,
             )
+        # drain the guard deque: every dispatched step's flag has a value
+        # by epoch end (the conversion below syncs anyway); a tail of bad
+        # steps can still trigger the rollback here
+        self._drain_guard_flags()
         if self.telemetry is not None and epoch_steps > 0:
             epoch_dur = time.time() - t_epoch
             self._emit_event(
@@ -1679,12 +1814,240 @@ class Trainer:
                 step=int(self.iteration), epoch=int(epoch),
             )
         metrics = {k: float(v) for k, v in metrics.items()}
+        metrics.pop("grads_nonfinite", None)  # guard plumbing, not a metric
         self.log.info(
             "epoch %d done in %.1f s (lr %.5f)",
             epoch, time.time() - t_epoch,
             float(self.epoch_schedule(jnp.asarray(float(epoch)))),
         )
         return metrics
+
+    # ------------------------------------------------------------------
+    # Resilience layer (ISSUE 5): graceful preemption drain, non-finite
+    # guard bookkeeping, rollback. utils/faults.py owns the deterministic
+    # injection plan; these methods own the live handling policy.
+    # ------------------------------------------------------------------
+
+    def _arm_signals(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain: finish the in-flight step,
+        write a step-indexed checkpoint, emit `preempt`, exit rc 75 (see
+        train_cli). Main thread only — signal.signal refuses elsewhere."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+        try:
+            self._prev_handlers = {
+                s: _signal.signal(s, self._on_preempt_signal)
+                for s in (_signal.SIGTERM, _signal.SIGINT)
+            }
+        except ValueError:  # non-main interpreter contexts
+            return
+        self._signals_armed = True
+
+    def _disarm_signals(self) -> None:
+        if not self._signals_armed:
+            return
+        for s, h in self._prev_handlers.items():
+            try:
+                _signal.signal(s, h)
+            except ValueError:
+                pass
+        self._signals_armed = False
+
+    def _on_preempt_signal(self, signum, frame) -> None:
+        # async-signal context: just set the flag; the step loop drains at
+        # the next step boundary (the in-flight dispatch completes first)
+        name = _signal.Signals(signum).name
+        if self._preempt_signal is not None:
+            # second signal before the drain reached a step boundary (a
+            # wedged step, or a slow drain checkpoint): escalate instead
+            # of silently re-setting the flag — disarm so a THIRD signal
+            # gets the default disposition (hard kill), and interrupt any
+            # Python-level wait now
+            self._disarm_signals()
+            raise KeyboardInterrupt(
+                f"second {name} during preemption drain — escalating "
+                "(next signal kills outright)"
+            )
+        self._preempt_signal = name
+
+    def _deliver_preempt(self, sig: int) -> None:
+        """Fault-plan preemption: deliver the real signal when our handler
+        is armed (exercising the production path), else set the flag
+        directly (train_epoch called outside fit, e.g. unit tests)."""
+        name = _signal.Signals(sig).name
+        if (
+            self._signals_armed
+            and threading.current_thread() is threading.main_thread()
+        ):
+            self.log.warning("fault injection: delivering %s to self", name)
+            os.kill(os.getpid(), sig)
+        else:
+            self.log.warning("fault injection: simulating %s", name)
+            self._preempt_signal = name
+
+    def _graceful_drain(self, epoch: int, epoch_pos: int) -> None:
+        """The in-flight step is done; checkpoint the exact position and
+        unwind with Preempted (train_cli converts it to rc 75)."""
+        name = self._preempt_signal or "SIGTERM"
+        self._pending_guard.clear()  # a drain outranks bad-step policy
+        if self.checkpointer is not None:
+            wd = getattr(self, "_watchdog", None)
+            if wd is not None:
+                from mgwfbp_tpu.utils.watchdog import CHECKPOINT_ALLOW_S
+
+                wd.beat("preemption drain checkpoint",
+                        allow_s=CHECKPOINT_ALLOW_S)
+            self.save_step(epoch, epoch_pos, wait=True)
+        else:
+            self.log.warning(
+                "preempted without --checkpoint-dir: progress NOT saved"
+            )
+        self._emit_event(
+            "preempt", signal=str(name), epoch=int(epoch),
+            iteration=int(self.iteration),
+        )
+        self.log.warning(
+            "preemption (%s): drained at epoch %d step %d (iter %d); "
+            "exiting restart-friendly", name, epoch, epoch_pos,
+            self.iteration,
+        )
+        raise Preempted(name, epoch, self.iteration)
+
+    def _graceful_drain_boundary(self, epoch: int) -> None:
+        """Preemption landing between epochs (eval/checkpoint phases):
+        write/refresh the boundary checkpoint and unwind."""
+        name = self._preempt_signal or "SIGTERM"
+        if self.checkpointer is not None:
+            self.save(epoch)
+            self.checkpointer.wait()
+        self._emit_event(
+            "preempt", signal=str(name), epoch=int(epoch),
+            iteration=int(self.iteration),
+        )
+        self.log.warning(
+            "preemption (%s): drained at epoch %d boundary (iter %d)",
+            name, epoch, self.iteration,
+        )
+        raise Preempted(name, epoch, self.iteration)
+
+    def _note_guard_flag(self, epoch: int, metrics) -> None:
+        """Queue this step's `grads_nonfinite` metric and examine the one
+        from the PREVIOUS step (already computed by now — reading it stalls
+        nothing and issues no device_get/block_until_ready, preserving the
+        PR-4 zero-sync contract)."""
+        if not self.config.grad_guard or not isinstance(metrics, dict):
+            return
+        flag = metrics.get("grads_nonfinite")
+        if flag is None:
+            return
+        self._pending_guard.append((self.iteration, epoch, flag))
+        if len(self._pending_guard) <= self._guard_interval:
+            return
+        # drain all but the newest (whose step may still be in flight):
+        # stacked into ONE device->host pull, so an interval of N costs
+        # one RTT per N steps instead of one per step
+        items = [
+            self._pending_guard.popleft()
+            for _ in range(len(self._pending_guard) - 1)
+        ]
+        self._check_guard_batch(items)
+
+    def _drain_guard_flags(self) -> None:
+        items = list(self._pending_guard)
+        self._pending_guard.clear()
+        self._check_guard_batch(items)
+
+    def _check_guard_batch(self, items: list) -> None:
+        if not items:
+            return
+        if len(items) == 1:
+            values = [float(items[0][2])]
+        else:
+            values = np.asarray(jnp.stack([f for _, _, f in items]))
+        for (it, ep, _), v in zip(items, values):
+            self._check_guard_value(it, ep, float(v))
+
+    def _check_guard_value(self, it: int, epoch: int, flag) -> None:
+        nonfinite = float(flag)
+        if nonfinite <= 0:
+            self._bad_streak = 0
+            self._good_step_since_rollback = True
+            return
+        self._bad_streak += 1
+        self.log.warning(
+            "non-finite gradients at iter %d (%g element(s)): update "
+            "dropped by the step guard (bad streak %d)",
+            it, nonfinite, self._bad_streak,
+        )
+        self._emit_event(
+            "bad_step", step=int(it), epoch=int(epoch),
+            nonfinite=float(nonfinite),
+        )
+        limit = self.config.bad_step_limit
+        if not limit or self._bad_streak < limit:
+            return
+        if (
+            self.checkpointer is not None
+            and self.checkpointer.latest_step() is not None
+        ):
+            raise _RollbackRequested(self._bad_streak)
+        if not getattr(self, "_warned_no_rollback", False):
+            self._warned_no_rollback = True
+            self.log.error(
+                "%d consecutive non-finite steps but no checkpoint to "
+                "roll back to (--checkpoint-dir unset or nothing saved); "
+                "continuing under the skip-step policy", self._bad_streak,
+            )
+
+    def _rollback(self, rb: _RollbackRequested) -> int:
+        """Restore the last checkpoint after K consecutive bad steps;
+        returns the epoch to continue from."""
+        snap = self.checkpointer.restore(
+            self._replicated_template_state(),
+            carry_template=self._carry_template(),
+        )
+        if snap is None:  # GC'd between check and restore — give up cleanly
+            raise RuntimeError(
+                "rollback requested but the checkpoint vanished"
+            ) from rb
+        if self._last_rollback_iteration is not None and (
+            snap.iteration == self._last_rollback_iteration
+            # mid-epoch saves during an all-bad streak advance the
+            # checkpoint ITERATION while the params stay frozen, so
+            # "different iteration" alone is not progress — a finite step
+            # must have been OBSERVED since the last rollback
+            or not self._good_step_since_rollback
+        ):
+            # the previous rollback's replay produced K consecutive bad
+            # steps again with no good step in between: the NaNs are
+            # persistent (lr/data/config), not transient — loop
+            # detection beats a silent forever-rollback livelock
+            raise RuntimeError(
+                f"persistent non-finite gradients: rollback to iter "
+                f"{snap.iteration} follows a rollback to iter "
+                f"{self._last_rollback_iteration} with no finite step "
+                f"observed in between ({rb.bad_steps} consecutive bad "
+                "steps again) — the NaN source is deterministic (check "
+                "lr, input pipeline, precision config); aborting instead "
+                "of looping"
+            ) from rb
+        self._last_rollback_iteration = snap.iteration
+        self._good_step_since_rollback = False
+        self._bad_streak = 0
+        self._pending_guard.clear()
+        self._warned_no_rollback = False
+        self._apply_snapshot(snap, "rolled back", emit_resume=False)
+        self._emit_event(
+            "rollback", bad_steps=int(rb.bad_steps),
+            restored_iteration=int(snap.iteration),
+            restored_epoch=int(snap.epoch),
+        )
+        self.log.warning(
+            "rollback: %d consecutive non-finite steps -> restored iter %d "
+            "(epoch %d%s)", rb.bad_steps, snap.iteration, snap.epoch,
+            f" step {snap.epoch_step}" if snap.mid_epoch else " boundary",
+        )
+        return self.start_epoch
 
     def evaluate(self) -> dict:
         """Eval over the val loader (reference test(), dl_trainer.py:854-937).
@@ -1696,6 +2059,12 @@ class Trainer:
         sums per metric plus ``count``; accumulation here is plain addition
         and one final divide by the summed count.
         """
+        stall_s = self._faults.stall_secs("eval", self.iteration)
+        if stall_s > 0:
+            self.log.warning(
+                "fault injection: stalling %.3g s in eval", stall_s
+            )
+            time.sleep(stall_s)
         loader = self.bundle.val
         sums: dict[str, float] = {}
         wer_total, wer_n = 0.0, 0
@@ -1842,6 +2211,8 @@ class Trainer:
         return {"wer": total / max(n, 1)}
 
     def save(self, epoch: int) -> None:
+        """Epoch-boundary checkpoint (step-indexed key = the iteration the
+        epoch ended on; the sidecar index marks it a boundary)."""
         if self.checkpointer is not None:
             # sharded opt state is gathered to the replicated optax form on
             # the way out: checkpoints stay interchangeable between comm
@@ -1855,8 +2226,39 @@ class Trainer:
             )
             self._emit_event(
                 "checkpoint", epoch=int(epoch),
-                iteration=int(self.iteration),
+                iteration=int(self.iteration), mid_epoch=False,
             )
+
+    def save_step(
+        self, epoch: int, epoch_step: int, wait: bool = False
+    ) -> None:
+        """Mid-epoch step-indexed checkpoint (--ckpt-every-steps and the
+        preemption drain): carries the data-iterator position — the
+        deterministic loader makes (epoch, epoch_step) the complete
+        iterator state — and the BPTT carry for stateful models, so a
+        restart resumes from the EXACT step, bitwise."""
+        if self.checkpointer is None:
+            return
+        carry = None
+        if self.meta.has_carry and self.carry is not None:
+            # host-materialize: the live carry is sharded over the data
+            # axis; the checkpoint form must be layout-independent
+            carry = jax.tree_util.tree_map(np.asarray, self.carry)
+        self.checkpointer.save(
+            Snapshot(
+                state=self._to_checkpoint_state(self.state),
+                epoch=epoch,
+                iteration=self.iteration,
+                epoch_step=epoch_step,
+                mid_epoch=True,
+                carry=carry,
+            ),
+            wait=wait,
+        )
+        self._emit_event(
+            "checkpoint", epoch=int(epoch), iteration=int(self.iteration),
+            mid_epoch=True, epoch_step=int(epoch_step),
+        )
 
     def close(self) -> None:
         if self.checkpointer is not None:
@@ -1876,7 +2278,10 @@ class Trainer:
 
         ckpt = Checkpointer(directory)
         try:
-            snap = ckpt.restore(self._replicated_template_state(), epoch=epoch)
+            snap = ckpt.restore(
+                self._replicated_template_state(), epoch=epoch,
+                carry_template=self._carry_template(),
+            )
         finally:
             ckpt.close()
         if snap is None:
@@ -1889,25 +2294,67 @@ class Trainer:
         )
         return snap
 
+    def _carry_template(self):
+        """Restore template for a checkpointed BPTT carry (host form)."""
+        if not self.meta.has_carry:
+            return None
+        return jax.tree_util.tree_map(
+            np.asarray, self.model.initial_carry(self.process_batch)
+        )
+
+    def _apply_snapshot(
+        self, snap: Snapshot, source: str, emit_resume: bool = True
+    ) -> None:
+        """Install a restored snapshot: state back onto the mesh (and
+        re-scattered for the sharded-opt path), counters, and — for a
+        mid-epoch snapshot — the exact data-iterator position so
+        train_epoch skips the already-consumed batches (shared by resume
+        and bad-step rollback; the latter passes emit_resume=False — it
+        emits its own `rollback` record, and a `resume` row means "a
+        restart picked up from a saved snapshot", which a rollback inside
+        one uninterrupted process is not)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.state = self._from_checkpoint_state(
+            jax.device_put(
+                snap.state, NamedSharding(self.mesh, PartitionSpec())
+            )
+        )
+        self.iteration = snap.iteration
+        if snap.mid_epoch:
+            self.start_epoch = snap.epoch
+            self._resume_epoch = snap.epoch
+            self._resume_skip_steps = snap.epoch_step
+            self._resume_carry = snap.carry
+        else:
+            self.start_epoch = snap.epoch + 1
+            self._resume_epoch = None
+            self._resume_skip_steps = 0
+            self._resume_carry = None
+        if emit_resume:
+            self._emit_event(
+                "resume", epoch=int(snap.epoch),
+                iteration=int(snap.iteration),
+                mid_epoch=bool(snap.mid_epoch),
+            )
+        self.log.info(
+            "%s from epoch %d (iter %d%s)", source, snap.epoch,
+            snap.iteration,
+            f", mid-epoch at step {snap.epoch_step}" if snap.mid_epoch
+            else "",
+        )
+
     def _maybe_resume(self) -> None:
         snap = None
         if self.checkpointer is not None:
             # checkpoints carry the replicated interchange form; restore
             # into that template, then re-scatter for the sharded path
-            snap = self.checkpointer.restore(self._replicated_template_state())
+            snap = self.checkpointer.restore(
+                self._replicated_template_state(),
+                carry_template=self._carry_template(),
+            )
         if snap is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            self.state = self._from_checkpoint_state(
-                jax.device_put(
-                    snap.state, NamedSharding(self.mesh, PartitionSpec())
-                )
-            )
-            self.start_epoch = snap.epoch + 1
-            self.iteration = snap.iteration
-            self.log.info(
-                "resumed from epoch %d (iter %d)", snap.epoch, snap.iteration
-            )
+            self._apply_snapshot(snap, "resumed")
             return
         if self.config.pretrain:
             # --pretrain initializes weights AND epoch/iter counters from
@@ -1950,6 +2397,8 @@ class Trainer:
             # watchdog_stall events), greppable next to the step records
             with ProgressWatchdog(on_stall=self._on_watchdog_stall) as wd:
                 self._watchdog = wd if wd.enabled else None
+                # SIGTERM/SIGINT -> graceful drain for the whole fit
+                self._arm_signals()
                 if cfg.autotune and self.autotune_report is None:
                     # closed-loop tuning phase: the first few real steps
                     # race candidate schedules (cache hit skips the race)
@@ -1968,17 +2417,25 @@ class Trainer:
                     # of live steps BEFORE the epoch loop (this one syncs;
                     # the loop itself never does)
                     self._measure_group_times_live()
-                metrics = self._fit_epochs(range(self.start_epoch, end), cfg)
+                metrics = self._fit_epochs(self.start_epoch, end, cfg)
         finally:
+            self._disarm_signals()
             self._watchdog = None
         if self.checkpointer is not None:
             self.checkpointer.wait()
         return metrics
 
-    def _fit_epochs(self, epochs, cfg) -> dict:
+    def _fit_epochs(self, start: int, end: int, cfg) -> dict:
         metrics: dict = {}
-        for epoch in epochs:
-            train_metrics = self.train_epoch(epoch)
+        epoch = start
+        while epoch < end:
+            try:
+                train_metrics = self.train_epoch(epoch)
+            except _RollbackRequested as rb:
+                # K consecutive non-finite steps: restore the last
+                # checkpoint and continue from its exact position
+                epoch = self._rollback(rb)
+                continue
             metrics = {"train": train_metrics}
             if self.writer is not None:
                 self.writer.add_scalars("epoch", train_metrics, epoch)
@@ -2004,4 +2461,9 @@ class Trainer:
                     wd.beat(f"checkpoint epoch {epoch}",
                             allow_s=CHECKPOINT_ALLOW_S)
                 self.save(epoch)
+            if self._preempt_signal is not None:
+                # the signal landed outside the step loop (eval or
+                # checkpoint phase); drain at the epoch boundary
+                self._graceful_drain_boundary(epoch)
+            epoch += 1
         return metrics

@@ -60,6 +60,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-schedule", dest="lr_schedule", default=None)
     p.add_argument("--logdir", default=None)
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
+    p.add_argument("--ckpt-every-steps", dest="ckpt_every_steps", type=int,
+                   default=None,
+                   help="mid-epoch step-indexed checkpoint every N optimizer "
+                        "steps (preemption-safe resume restarts from the "
+                        "exact step; 0 = epoch boundaries only)")
+    p.add_argument("--no-grad-guard", action="store_true",
+                   help="disable the non-finite-gradient guard (by default "
+                        "a NaN/inf gradient drops that update, emits a "
+                        "bad_step event, and K consecutive bad steps roll "
+                        "back to the last checkpoint)")
+    p.add_argument("--bad-step-limit", dest="bad_step_limit", type=int,
+                   default=None,
+                   help="consecutive non-finite steps before rollback to "
+                        "the last checkpoint (0 disables rollback)")
     p.add_argument("--pretrain", default=None,
                    help="checkpoint directory to initialize weights from")
     p.add_argument("--seed", type=int, default=None)
@@ -143,12 +157,14 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "logdir", "checkpoint_dir", "pretrain", "seed", "seq_parallel",
             "num_steps", "num_batches_per_epoch", "compressor", "density",
             "comm_op", "dcn_slices", "autotune_steps", "schedule_cache",
-            "telemetry_dir",
+            "telemetry_dir", "ckpt_every_steps", "bad_step_limit",
         )
         if getattr(args, k, None) is not None
     }
     if args.no_augment:
         overrides["augment"] = False
+    if args.no_grad_guard:
+        overrides["grad_guard"] = False
     if args.tensorboard:
         overrides["tensorboard"] = True
     if args.telemetry or args.telemetry_dir:
@@ -208,8 +224,18 @@ def main(argv: Optional[list[str]] = None) -> int:
         profile_backward=not args.no_profile_backward,
         synthetic_data=True if args.synthetic else None,
     )
+    from mgwfbp_tpu.utils.faults import PREEMPT_RC, Preempted
+
     try:
         metrics = trainer.fit(args.epochs)
+    except Preempted as p:
+        # graceful drain already checkpointed and emitted the preempt
+        # event; EX_TEMPFAIL tells the supervisor "restart me to resume"
+        print(json.dumps({
+            "preempted": True, "signal": p.signal_name,
+            "epoch": p.epoch, "iteration": p.iteration,
+        }))
+        return PREEMPT_RC
     finally:
         trainer.close()
     print(json.dumps(metrics))

@@ -1,0 +1,273 @@
+"""Deterministic fault-injection harness (resilience layer, ISSUE 5).
+
+MG-WFBP is synchronous data-parallel SGD: every merge-group collective is a
+barrier, so the interesting failure modes — a non-finite gradient, a wedged
+dispatch, a preempted host, a chip that never grants — are all *rare* in CI
+and *routine* in production. This module makes each of them a first-class,
+reproducible test input: a fault plan names exactly which fault fires at
+which optimizer step (or phase), so every handling path (skip-step guard,
+watchdog escalation, graceful preemption drain, bench chip-unavailable
+skip) runs in tier-1 on the CPU mesh instead of being dead code until the
+first real outage.
+
+Plan grammar (``MGWFBP_FAULT_PLAN``)::
+
+    plan  := spec (';' spec)*
+    spec  := kind ('@' kv (',' kv)*)?
+    kind  := 'nan' | 'stall' | 'preempt' | 'chip_unavailable'
+    kv    := key '=' value
+
+    nan@step=N[,count=C]        poison the batch of optimizer steps
+                                N..N+C-1 (1-indexed, host iteration
+                                counter) with NaN inputs -> non-finite
+                                gradients after the allreduce
+    stall@secs=S[,phase=P][,step=N]
+                                sleep S seconds inside phase P ('train'
+                                default, or 'eval'); with step=N only at
+                                that step; fires ONCE
+    preempt@step=N[,signal=SIGTERM|SIGINT]
+                                deliver the preemption signal after step N
+                                completes (the graceful-drain path); ONCE
+    chip_unavailable            backend init reports the chip as
+                                unavailable (bench.py's ChipUnavailable
+                                structured-skip path)
+
+Everything is keyed on deterministic host counters — no randomness — so a
+faulted run is exactly reproducible, and a resumed run whose iteration
+counter is already past a fault's step does not re-fire it.
+
+Injection stays OUTSIDE the jitted step: NaNs enter through the host batch
+(poisoning the inputs makes every post-allreduce gradient non-finite
+without recompiling anything), stalls/preemptions are host-side events.
+The hot path of an unfaulted run pays one truthiness check per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Optional
+
+ENV_VAR = "MGWFBP_FAULT_PLAN"
+
+# Exit code after a graceful preemption drain: EX_TEMPFAIL, the
+# conventional "transient — try again" status, so supervisors (and the
+# fault-injection smoke in tools/check.sh) can tell "restart me, progress
+# is checkpointed" from a real failure.
+PREEMPT_RC = 75
+
+
+class Preempted(RuntimeError):
+    """A preemption signal (SIGTERM/SIGINT) was drained gracefully: the
+    in-flight step finished, a step-indexed checkpoint was written, the
+    `preempt` telemetry event is in the stream. The launcher converts
+    this into exit code PREEMPT_RC."""
+
+    def __init__(self, signal_name: str, epoch: int, iteration: int):
+        super().__init__(
+            f"preempted by {signal_name} at epoch {epoch} iteration "
+            f"{iteration}; progress checkpointed — restart to resume"
+        )
+        self.signal_name = signal_name
+        self.epoch = epoch
+        self.iteration = iteration
+
+KINDS = ("nan", "stall", "preempt", "chip_unavailable")
+_ALLOWED_KEYS = {
+    "nan": {"step", "count"},
+    "stall": {"secs", "phase", "step"},
+    "preempt": {"step", "signal"},
+    "chip_unavailable": set(),
+}
+_REQUIRED_KEYS = {
+    "nan": {"step"},
+    "stall": {"secs"},
+    "preempt": {"step"},
+    "chip_unavailable": set(),
+}
+_SIGNALS = {"SIGTERM": signal.SIGTERM, "SIGINT": signal.SIGINT}
+# the phases the trainer actually queries; an unknown phase would parse
+# and then silently never fire — the no-op the grammar check exists to stop
+_PHASES = ("train", "eval")
+
+GRAMMAR = (
+    "expected 'kind@key=val,...' specs joined by ';' with kind in "
+    f"{KINDS} — e.g. 'nan@step=3;preempt@step=6' (see utils/faults.py)"
+)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    step: Optional[int] = None
+    count: int = 1
+    secs: float = 0.0
+    phase: str = "train"
+    signal: str = "SIGTERM"
+    fired: bool = False  # one-shot kinds (stall/preempt) consume themselves
+    fired_steps: set = dataclasses.field(default_factory=set)  # nan kind
+    observed_below: bool = False  # preempt: a step < `step` was seen, so
+    # reaching `step` is a live crossing, not a resumed counter landing
+    # past a fault that already fired in the previous process
+
+    def describe(self) -> str:
+        kv = []
+        if self.step is not None:
+            kv.append(f"step={self.step}")
+        if self.kind == "nan" and self.count != 1:
+            kv.append(f"count={self.count}")
+        if self.kind == "stall":
+            kv.append(f"secs={self.secs:g}")
+            kv.append(f"phase={self.phase}")
+        if self.kind == "preempt":
+            kv.append(f"signal={self.signal}")
+        return self.kind + ("@" + ",".join(kv) if kv else "")
+
+
+def parse_plan(text: str) -> "FaultPlan":
+    """Parse a plan string; malformed input raises ValueError naming the
+    offending spec and the grammar (a typo'd fault plan silently injecting
+    nothing would defeat the whole point of deterministic injection)."""
+    specs: list[FaultSpec] = []
+    for raw in text.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, argstr = raw.partition("@")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault plan: unknown kind {kind!r} in {raw!r}; {GRAMMAR}"
+            )
+        kv: dict[str, str] = {}
+        if argstr:
+            for item in argstr.split(","):
+                key, sep, val = item.partition("=")
+                key, val = key.strip(), val.strip()
+                if not sep or not key or not val:
+                    raise ValueError(
+                        f"fault plan: malformed arg {item!r} in {raw!r}; "
+                        f"{GRAMMAR}"
+                    )
+                if key not in _ALLOWED_KEYS[kind]:
+                    raise ValueError(
+                        f"fault plan: {kind!r} takes keys "
+                        f"{sorted(_ALLOWED_KEYS[kind])}, got {key!r}"
+                    )
+                kv[key] = val
+        missing = _REQUIRED_KEYS[kind] - kv.keys()
+        if missing:
+            raise ValueError(
+                f"fault plan: {raw!r} missing required key(s) "
+                f"{sorted(missing)}; {GRAMMAR}"
+            )
+        spec = FaultSpec(kind=kind)
+        try:
+            if "step" in kv:
+                spec.step = int(kv["step"])
+            if "count" in kv:
+                spec.count = int(kv["count"])
+            if "secs" in kv:
+                spec.secs = float(kv["secs"])
+        except ValueError:
+            raise ValueError(
+                f"fault plan: non-numeric value in {raw!r}; {GRAMMAR}"
+            ) from None
+        if "phase" in kv:
+            if kv["phase"] not in _PHASES:
+                raise ValueError(
+                    f"fault plan: phase must be one of {list(_PHASES)}, "
+                    f"got {kv['phase']!r}"
+                )
+            spec.phase = kv["phase"]
+        if "signal" in kv:
+            sig = kv["signal"].upper()
+            if sig not in _SIGNALS:
+                raise ValueError(
+                    f"fault plan: signal must be one of "
+                    f"{sorted(_SIGNALS)}, got {kv['signal']!r}"
+                )
+            spec.signal = sig
+        if spec.kind == "nan" and spec.count < 1:
+            raise ValueError("fault plan: nan count must be >= 1")
+        if spec.kind == "stall" and spec.secs < 0:
+            raise ValueError("fault plan: stall secs must be >= 0")
+        specs.append(spec)
+    return FaultPlan(specs)
+
+
+class FaultPlan:
+    """Parsed fault plan; the trainer/bench query it at phase boundaries."""
+
+    def __init__(self, specs: Optional[list[FaultSpec]] = None):
+        self.specs = list(specs or [])
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultPlan":
+        text = (environ or os.environ).get(ENV_VAR, "")
+        if not text.strip():
+            return cls([])
+        return parse_plan(text)
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def describe(self) -> str:
+        return "; ".join(s.describe() for s in self.specs)
+
+    # -- queries (all deterministic in the host counters) -----------------
+    def nan_at(self, step: int) -> bool:
+        """True when optimizer step `step` (1-indexed) must see NaN grads.
+
+        Each planned step fires ONCE — the fault models a transient flip
+        (bad DMA, cosmic ray), so a rollback-and-replay of the same step
+        sees clean data; otherwise a deterministic plan would re-poison
+        every replay and rollback could never converge."""
+        for s in self.specs:
+            if (
+                s.kind == "nan"
+                and s.step <= step < s.step + s.count
+                and step not in s.fired_steps
+            ):
+                s.fired_steps.add(step)
+                return True
+        return False
+
+    def stall_secs(self, phase: str, step: Optional[int] = None) -> float:
+        """Seconds to stall in `phase` at `step` (0.0 = no stall). One-shot:
+        a matching spec is consumed so the stall fires exactly once. A
+        spec with a step= constraint fires ONLY when the caller reports
+        exactly that step — never "on the first call", which would move
+        the injected wedge to a different point than the plan names."""
+        for s in self.specs:
+            if s.kind != "stall" or s.fired or s.phase != phase:
+                continue
+            if s.step is not None and s.step != step:
+                continue
+            s.fired = True
+            return s.secs
+        return 0.0
+
+    def preempt_signal_after(self, step: int) -> Optional[int]:
+        """Signal number to deliver after step `step` completed, or None.
+        One-shot, and fires only on a live CROSSING of the planned step:
+        landing exactly on `step`, or reaching it after a smaller step was
+        observed in THIS process. A resumed run whose counter is already
+        past `step` consumes the spec silently — the fault fired in the
+        previous life, and re-delivering it would preempt every restart
+        forever when a supervisor re-runs the same command (same env, same
+        plan) on rc PREEMPT_RC."""
+        for s in self.specs:
+            if s.kind != "preempt" or s.fired:
+                continue
+            if step < s.step:
+                s.observed_below = True
+                continue
+            s.fired = True
+            if s.observed_below or step == s.step:
+                return _SIGNALS[s.signal]
+        return None
+
+    def chip_unavailable(self) -> bool:
+        return any(s.kind == "chip_unavailable" for s in self.specs)

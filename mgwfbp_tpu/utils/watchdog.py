@@ -18,7 +18,10 @@ interval).
 
 from __future__ import annotations
 
+import faulthandler
+import logging
 import os
+import sys
 import threading
 import time
 from typing import Optional
@@ -89,6 +92,35 @@ class ProgressWatchdog:
         self._last = time.monotonic()
         self._allow = max(float(allow_s), 0.0)
 
+    def _dump_all_stacks(self) -> None:
+        """faulthandler dump of EVERY thread to stderr and to any log
+        files the framework has open — the escalation step: a stalled run
+        (especially one about to abort) must leave the blocked C-call's
+        Python frames on disk, or a wedged dispatch is undiagnosable
+        post-mortem. faulthandler is async-safe and needs no cooperation
+        from the stuck thread."""
+        streams = [sys.stderr]
+        for name in ("mgwfbp.trainer", "mgwfbp.watchdog"):
+            for h in logging.getLogger(name).handlers:
+                stream = getattr(h, "stream", None)
+                if stream is not None and stream not in streams:
+                    streams.append(stream)
+        for s in streams:
+            try:
+                s.write(
+                    f"\n== watchdog stall in {self._phase!r}: all-thread "
+                    "traceback dump ==\n"
+                )
+                # flush BEFORE the dump: faulthandler writes straight to
+                # the fd, bypassing the Python buffer the banner sits in —
+                # without this the banner lands AFTER the tracebacks
+                s.flush()
+                faulthandler.dump_traceback(file=s, all_threads=True)
+                s.flush()
+            except Exception:  # noqa: BLE001 — a closed/broken stream
+                # must not mask the remaining dump targets or the abort
+                continue
+
     def _watch(self) -> None:
         while not self._stop.wait(min(self.check_interval_s, self.timeout_s)):
             idle = time.monotonic() - self._last
@@ -103,6 +135,10 @@ class ProgressWatchdog:
                     if self.abort
                     else "",
                 )
+                # escalation BEFORE the optional abort: the stack dump is
+                # the post-mortem; os._exit would otherwise take the
+                # evidence down with the process
+                self._dump_all_stacks()
                 if self.on_stall is not None:
                     try:
                         self.on_stall(

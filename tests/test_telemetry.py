@@ -33,7 +33,7 @@ def test_event_stream_round_trip(tmp_path):
     path = str(tmp_path / "telemetry.jsonl")
     w = EventWriter(path, run={"model": "lenet", "world": 8})
     w.emit("step", step=1, epoch=0, start_s=0.0, dur_s=0.1)
-    w.emit("checkpoint", epoch=0, iteration=1)
+    w.emit("checkpoint", epoch=0, iteration=1, mid_epoch=False)
     w.emit("watchdog_stall", phase="train epoch 0", idle_s=12.0,
            timeout_s=10.0, abort=False)
     w.emit("scalar", tag="train/loss", value=2.3, step=1)

@@ -7,20 +7,23 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/4] ruff =="
+echo "== [1/5] ruff =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mgwfbp_tpu tests tools bench.py || rc=1
 else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/4] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
+echo "== [2/5] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
 JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
 
-echo "== [3/4] telemetry report smoke (writer -> report -> exports) =="
+echo "== [3/5] telemetry report smoke (writer -> report -> exports) =="
 JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
 
-echo "== [4/4] tier-1 tests =="
+echo "== [4/5] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
+JAX_PLATFORMS=cpu python tools/fault_smoke.py || rc=1
+
+echo "== [5/5] tier-1 tests =="
 t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
 trap 'rm -f "$t1log"' EXIT                 # must not clobber each other
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

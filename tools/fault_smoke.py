@@ -1,0 +1,106 @@
+"""Fault-injection smoke: the resilience lifecycle, end to end, on the CPU
+mesh (tools/check.sh stage).
+
+Drives the REAL launcher twice through subprocesses:
+
+  1. a lenet run with ``MGWFBP_FAULT_PLAN="nan@step=2;preempt@step=4"`` —
+     must drop the NaN step (``bad_step`` event), drain the injected
+     SIGTERM gracefully (step-indexed checkpoint + ``preempt`` event) and
+     exit rc 75 (EX_TEMPFAIL, restart-friendly);
+  2. the same command with no fault plan — must resume from the exact
+     mid-epoch step (``resume`` event with mid_epoch) and finish rc 0.
+
+Asserts the telemetry lifecycle after each run. No accelerator, dataset,
+or network needed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+PREEMPT_RC = 75  # mirrors mgwfbp_tpu.utils.faults.PREEMPT_RC
+
+
+def _cli(logdir: str) -> list[str]:
+    return [
+        sys.executable, "-m", "mgwfbp_tpu.train_cli",
+        "--dnn", "lenet", "--synthetic", "--no-profile-backward",
+        "--batch-size", "8", "--num-batches-per-epoch", "6",
+        "--max-epochs", "2", "--epochs", "2", "--seed", "7",
+        "--logdir", logdir,
+        "--checkpoint-dir", os.path.join(logdir, "ckpt"),
+        "--ckpt-every-steps", "2", "--telemetry",
+    ]
+
+
+def _run(logdir: str, fault_plan: str) -> int:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MGWFBP_FAULT_PLAN"] = fault_plan
+    env.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    proc = subprocess.run(
+        _cli(logdir), env=env, cwd=_ROOT, capture_output=True, text=True,
+        timeout=600,
+    )
+    if proc.returncode not in (0, PREEMPT_RC):
+        sys.stderr.write(proc.stdout[-4000:] + proc.stderr[-4000:])
+    return proc.returncode
+
+
+def _events(logdir: str) -> list[dict]:
+    from mgwfbp_tpu.telemetry import read_event_set
+
+    paths = glob.glob(os.path.join(logdir, "*", "telemetry.jsonl"))
+    assert len(paths) == 1, f"expected one telemetry stream, got {paths}"
+    return read_event_set(paths[0])
+
+
+def main() -> int:
+    from mgwfbp_tpu.telemetry import events_of
+
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_fault_smoke_") as d:
+        rc = _run(d, "nan@step=2;preempt@step=4")
+        assert rc == PREEMPT_RC, (
+            f"faulted run exited rc {rc}, want {PREEMPT_RC} (EX_TEMPFAIL)"
+        )
+        recs = _events(d)
+        bad = events_of(recs, "bad_step")
+        assert bad and bad[0]["step"] == 2, f"bad_step missing/wrong: {bad}"
+        assert bad[0]["nonfinite"] > 0
+        (pre,) = events_of(recs, "preempt")
+        assert pre["signal"] == "SIGTERM" and pre["iteration"] == 4, pre
+        ckpts = events_of(recs, "checkpoint")
+        assert any(c.get("mid_epoch") for c in ckpts), ckpts
+
+        rc = _run(d, "")
+        assert rc == 0, f"resume run exited rc {rc}"
+        recs = _events(d)
+        resumes = events_of(recs, "resume")
+        assert resumes and resumes[-1]["mid_epoch"], resumes
+        assert resumes[-1]["iteration"] == 4, resumes
+        steps = events_of(recs, "step")
+        assert max(s["step"] for s in steps) == 12, (
+            "resumed run did not finish both epochs"
+        )
+        print(json.dumps({
+            "fault_smoke": "ok",
+            "bad_steps": len(bad),
+            "preempt_iteration": pre["iteration"],
+            "resume_iteration": resumes[-1]["iteration"],
+            "final_step": max(s["step"] for s in steps),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -133,6 +133,20 @@ def format_report(records: list[dict]) -> str:
             f"{_fmt_s(r.get('idle_s'))} s"
             + (" (aborted)" if r.get("abort") else ""))),
         ("bench_skip", lambda r: f"bench skipped: {r.get('detail')}"),
+        ("bad_step", lambda r: (
+            f"BAD STEP {r.get('step')} (epoch {r.get('epoch')}): "
+            f"{_fmt_s(r.get('nonfinite'))} non-finite gradient element(s), "
+            "update dropped")),
+        ("rollback", lambda r: (
+            f"ROLLBACK after {r.get('bad_steps')} consecutive bad steps "
+            f"-> restored iter {r.get('restored_iteration')} "
+            f"(epoch {r.get('restored_epoch')})")),
+        ("preempt", lambda r: (
+            f"PREEMPTED by {r.get('signal')} at epoch {r.get('epoch')} "
+            f"iter {r.get('iteration')} (checkpointed, rc 75)")),
+        ("resume", lambda r: (
+            f"resumed at epoch {r.get('epoch')} iter {r.get('iteration')}"
+            + (" (mid-epoch)" if r.get("mid_epoch") else " (boundary)"))),
     ):
         for r in events_of(records, ev):
             lifecycle.append(render(r))
@@ -174,7 +188,7 @@ def _synthetic_stream(path: str) -> None:
         )
     w.emit("resize", old_world=8, new_world=4,
            schedule_source="schedule-cache", num_groups=2)
-    w.emit("checkpoint", epoch=0, iteration=24)
+    w.emit("checkpoint", epoch=0, iteration=24, mid_epoch=False)
     w.close()
 
 
@@ -232,13 +246,17 @@ def main(argv=None) -> int:
     path = args.events
     if os.path.isdir(path):
         path = os.path.join(path, "telemetry.jsonl")
-    if not os.path.exists(path):
+
+    # read_event_set handles size-rotated streams (telemetry.jsonl.0000,
+    # .0001, ... + the active file) as one continuous timeline; a bare
+    # un-rotated file reads identically
+    from mgwfbp_tpu.telemetry import read_event_set
+
+    try:
+        records = read_event_set(path)
+    except FileNotFoundError:
         print(f"telemetry_report: no events file at {path}", file=sys.stderr)
         return 2
-
-    from mgwfbp_tpu.telemetry import read_events
-
-    records = read_events(path)
     print(format_report(records))
     if args.chrome_trace:
         from mgwfbp_tpu.telemetry.export import write_chrome_trace
