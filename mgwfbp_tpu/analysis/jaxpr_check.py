@@ -58,9 +58,13 @@ CALLBACK_PRIMS = frozenset({
 # "sharded_clip_norm" is the rs_opt_ag lowering's one cross-group psum of
 # shard squared norms (global-norm clipping while every bucket is
 # scattered) — parallel/allreduce.py CLIP_NORM_SCOPE, keep in sync.
+# "runtime_coord" is the multi-host runtime's agreement psum/pmax
+# (runtime/coordination.py COORD_SCOPE, keep in sync): today those run as
+# standalone host-decision programs, but a step that ever traces one in
+# stays verifier-clean by declaration instead of tripping SCH004.
 DEFAULT_ALLOWED_SCOPES = (
     "metrics_reduce", "bstats_reduce", "flat_grad_reduce",
-    "sharded_clip_norm",
+    "sharded_clip_norm", "runtime_coord",
 )
 
 

@@ -37,6 +37,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from mgwfbp_tpu.runtime import coordination as coord
 from mgwfbp_tpu.train.step import TrainState
 
 INDEX_FILE = "steps_index.json"
@@ -107,6 +108,13 @@ class Checkpointer:
         # write-temp + rename so a mid-write kill never corrupts the index
         live = {str(s) for s in self._mgr.all_steps()}
         self._index = {k: v for k, v in self._index.items() if k in live}
+        if not coord.is_primary():
+            # multi-host: exactly ONE writer for the sidecar — every
+            # process keeps the same in-memory index (the save/restore
+            # calls are collective), but two processes racing the
+            # tmp+rename on a shared FS could commit a torn view; the
+            # commit barrier in save() orders everyone behind process 0
+            return
         tmp = self._index_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"version": INDEX_VERSION, "steps": self._index}, f)
@@ -119,7 +127,15 @@ class Checkpointer:
         The orbax step key is the GLOBAL iteration. Saving a step that
         already exists (an epoch boundary landing on a just-written
         ``--ckpt-every-steps`` checkpoint) only updates the index metadata
-        — the state payload is identical by construction."""
+        — the state payload is identical by construction.
+
+        Multi-host: `save` is a COLLECTIVE — every process calls it with
+        the same snapshot (orbax coordinates the payload so the tmp-dir +
+        atomic-rename commit happens exactly once, on the primary); the
+        sidecar index is written by process 0 only (`_write_index`), and
+        a commit barrier at the end keeps any process from returning —
+        and, on the preemption-drain path, EXITING — before the commit is
+        durable, so a preempt mid-save can never leave torn state."""
         step = int(snap.iteration)
         entry = {
             "epoch": int(snap.epoch),
@@ -148,6 +164,7 @@ class Checkpointer:
                 # save; an explicit durability request (preemption drain)
                 # must not be dropped just because the bytes are deduped
                 self._mgr.wait_until_finished()
+            self._commit_barrier(step)
             return
         payload = {
             "state": snap.state,
@@ -166,6 +183,14 @@ class Checkpointer:
         self._write_index()
         if wait:
             self._mgr.wait_until_finished()
+        self._commit_barrier(step)
+
+    def _commit_barrier(self, step: int) -> None:
+        """Multi-host rendezvous at the end of every save: no process may
+        proceed until process 0's sidecar commit (and, for wait=True, the
+        orbax payload commit) is on disk. No-op single-process."""
+        if coord.process_count() > 1:
+            coord.barrier(f"ckpt_commit_{step}")
 
     def _gc(self) -> None:
         """Class-aware retention: keep the newest `max_to_keep`

@@ -41,6 +41,63 @@ class MeshSpec:
     dcn: int = 1  # slices of a multi-slice pod (outer data-parallel level)
 
 
+def _enable_cpu_collectives() -> None:
+    """Multi-process collectives on the CPU backend need the gloo TCP
+    implementation; the default ('none') makes EVERY cross-process program
+    fail with "Multiprocess computations aren't implemented on the CPU
+    backend" — the rot that kept the multi-host path dead code until
+    ISSUE 6. Must run before the CPU client is created. Applied
+    unconditionally on multi-process launches: the knob only affects CPU
+    client construction (a TPU run's secondary CPU backend is unharmed),
+    and gating on platform env vars would silently re-kill a CPU-only
+    launch that never exported JAX_PLATFORMS."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # older/newer jax renamed it
+        pass
+
+
+def _env_int(env, name: str) -> Optional[int]:
+    """Integer env var; empty/whitespace counts as unset (launcher
+    scripts export from possibly-unset shell variables), garbage fails
+    with the variable named instead of a bare int() traceback."""
+    v = (env.get(name) or "").strip()
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name}={v!r} is not an integer") from None
+
+
+def resolve_launch_env(
+    env=None,
+) -> tuple[Optional[str], Optional[int], Optional[int]]:
+    """(coordinator, num_processes, process_id) from the launcher ENV
+    chain: MGWFBP_COORDINATOR / MGWFBP_NUM_PROCESSES / MGWFBP_PROCESS_ID
+    (the supervisor's launch contract) first, then the standard launcher
+    envs (SLURM, OpenMPI) — consulted only when the MGWFBP contract is
+    silent, and only when they signal a real multi-task allocation (a
+    1-task world is not a multi-host signal). This is the ONE owner of
+    the env half of the resolution chain; `train_cli.resolve_multihost`
+    layers explicit flags and completeness validation on top, and
+    `init_distributed` falls back to it for non-CLI entry points."""
+    env = os.environ if env is None else env
+    coordinator = (env.get("MGWFBP_COORDINATOR") or "").strip() or None
+    num = _env_int(env, "MGWFBP_NUM_PROCESSES")
+    pid = _env_int(env, "MGWFBP_PROCESS_ID")
+    if num is None and pid is None:
+        for size_var, rank_var in (
+            ("SLURM_NTASKS", "SLURM_PROCID"),
+            ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
+        ):
+            n = _env_int(env, size_var)
+            if n is not None and n > 1:
+                num, pid = n, _env_int(env, rank_var)
+                break
+    return coordinator, num, pid
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -49,28 +106,32 @@ def init_distributed(
     """Multi-host bootstrap (reference: `hvd.init()` / mpirun). No-op when
     single-process or when jax.distributed is already initialized.
 
-    Passing coordinator_address/process_id signals an explicit multi-host
-    launch; silently skipping initialization there would leave each host
-    training unsynchronized, so a missing worker count is an error instead.
+    Arguments left None fall back to `resolve_launch_env` (the
+    supervisor's MGWFBP_* contract, then SLURM/OpenMPI), so non-CLI entry
+    points resolve the same launch train_cli would. Passing
+    coordinator_address/process_id signals an explicit multi-host launch;
+    silently skipping initialization there would leave each host training
+    unsynchronized, so a missing worker count is an error instead.
     """
+    env_coord, env_num, env_pid = resolve_launch_env()
+    if coordinator_address is None:
+        coordinator_address = env_coord
+    if process_id is None:
+        process_id = env_pid
     explicit = coordinator_address is not None or process_id is not None
     if num_processes is None:
-        # empty/whitespace counts as unset: launcher scripts export the var
-        # from possibly-unset shell variables, and int("") would crash an
-        # otherwise valid single-host run
-        env = (os.environ.get("MGWFBP_NUM_PROCESSES") or "").strip()
-        if env:
-            num_processes = int(env)
-        elif explicit:
-            raise ValueError(
-                "init_distributed: coordinator_address/process_id given but "
-                "num_processes unknown; pass num_processes or set "
-                "MGWFBP_NUM_PROCESSES"
-            )
-        else:
+        num_processes = env_num
+        if num_processes is None:
+            if explicit:
+                raise ValueError(
+                    "init_distributed: coordinator_address/process_id "
+                    "given but num_processes unknown; pass num_processes "
+                    "or set MGWFBP_NUM_PROCESSES"
+                )
             return
     if num_processes <= 1 and not explicit:
         return
+    _enable_cpu_collectives()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1,0 +1,50 @@
+"""Multi-host production runtime (ISSUE 6).
+
+MG-WFBP is synchronous data-parallel SGD across many workers
+(arXiv:1811.11141); one process per host, every merge-group collective a
+barrier. That shape makes every HOST-side decision a distributed-consensus
+problem: if two processes disagree on "drain now?", "roll back?", or
+"which autotune candidate won?", they issue different collective programs
+and the whole group deadlocks. This package is the substrate that makes
+the `MGWFBP_NUM_PROCESSES>1` path production-real:
+
+  coordination  small agreement primitives (broadcast_flag, all_argmin,
+                agree_all/agree_any, barrier) every cross-process decision
+                in the trainer/checkpointer/autotuner routes through;
+  supervisor    process-group launcher + auto-resubmit policy: rc 75
+                (EX_TEMPFAIL, graceful preemption drain) resubmits the
+                whole group with bounded exponential backoff, rc 86
+                (watchdog abort) stops and surfaces the stack dumps, any
+                other death tears down the stragglers.
+
+`python -m mgwfbp_tpu.runtime.supervise -- <train_cli args>` is the
+entry point (README "Multi-host runtime").
+"""
+
+from __future__ import annotations
+
+
+class ResizeUnsupported(RuntimeError):
+    """Elastic resize was requested in a configuration that only supports
+    resize-by-relaunch (multi-host process groups, multi-slice meshes).
+
+    The supported path: drain (checkpoints are step-indexed and bitwise
+    resumable), then relaunch the whole group at the new size under the
+    supervisor — the message carries the recipe.
+    """
+
+    def __init__(self, reason: str, nworkers: int):
+        super().__init__(
+            f"{reason}. Elastic resize on this configuration is "
+            "resize-by-relaunch: stop the group (SIGTERM drains to a "
+            "step-indexed checkpoint, rc 75), then relaunch at the new "
+            "size under the supervisor —\n"
+            "  python -m mgwfbp_tpu.runtime.supervise --processes <N> -- "
+            "<same train args>\n"
+            "The resumed run restores bitwise from the drained checkpoint "
+            f"(requested worker count: {nworkers})."
+        )
+        self.nworkers = nworkers
+
+
+__all__ = ["ResizeUnsupported"]

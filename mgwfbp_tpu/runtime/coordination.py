@@ -1,0 +1,232 @@
+"""Cross-process agreement primitives for the multi-host runtime.
+
+Synchronous data-parallel SGD means every host-side decision that changes
+which jitted program runs next — drain on preemption, roll back after K
+bad steps, commit an autotune winner — must be IDENTICAL on every
+process, or the processes issue mismatched collectives and the group
+deadlocks (the failure mode the PR-3 autotuner refused multi-host over).
+These primitives make that identity explicit and cheap:
+
+  agree_any / agree_all   boolean consensus over one flag per process
+  broadcast_flag          process-`source`'s value, everywhere
+  all_argmin              per-candidate times -> one agreed winner index
+                          (each candidate priced at its SLOWEST process —
+                          a sync group can't run faster than its straggler)
+  barrier                 named rendezvous with a real timeout
+
+Transport: one tiny jitted psum/pmax over a throwaway 1-axis mesh of all
+global devices (the `jax.experimental.multihost_utils` building block,
+re-implemented here because `process_allgather`'s single-device reshard
+is unimplemented on the CPU backend this repo's tier-1 runs on). Each
+process contributes its payload on its FIRST local device and the
+reduction identity elsewhere, so the psum sums exactly once per process.
+The collectives carry the `runtime_coord` name scope — declared in
+`analysis/jaxpr_check.py` DEFAULT_ALLOWED_SCOPES, so a future step that
+traces an agreement into a jitted program stays verifier-clean (SCH004).
+
+Every primitive is a LOCKSTEP COLLECTIVE when `process_count() > 1`:
+all processes must call the same primitives in the same order with
+same-shaped payloads (the same invariant their jitted steps already
+obey). Single-process calls short-circuit on the host — zero device
+work, so these are safe to leave in single-host hot paths.
+
+Payloads ride float32 on the device (jax x64 is off): exact for flags,
+counts below 2**24, and wall-clock seconds — the only things routed
+through here.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mgwfbp_tpu.utils.platform import get_shard_map, run_with_deadline
+
+# 1-axis mesh over every global device, used only by these primitives
+COORD_AXIS = "coord"
+# name scope stamped on the agreement collectives (jaxpr_check SCH004
+# allowed scope — keep in sync with analysis/jaxpr_check.py)
+COORD_SCOPE = "runtime_coord"
+
+# default barrier timeout; a peer that never arrives means a dead or
+# wedged process — fail so the supervisor can tear down and resubmit
+BARRIER_TIMEOUT_ENV = "MGWFBP_BARRIER_TIMEOUT_S"
+DEFAULT_BARRIER_TIMEOUT_S = 600.0
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the process that owns exactly-once side effects (sidecar
+    index writes, autotune cache persistence, ...)."""
+    return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# device transport
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _coord_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()), (COORD_AXIS,))
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_prog(kind: str):
+    """Jitted (n_devices, k) -> replicated (k,) reduction program."""
+    mesh = _coord_mesh()
+    shard_map = get_shard_map()
+
+    def body(x):
+        with jax.named_scope(COORD_SCOPE):
+            if kind == "sum":
+                return lax.psum(jnp.sum(x, axis=0), COORD_AXIS)
+            return lax.pmax(jnp.max(x, axis=0), COORD_AXIS)
+
+    return jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P(COORD_AXIS), out_specs=P())
+    )
+
+
+def _device_reduce(vals: Sequence[float], kind: str) -> np.ndarray:
+    """Reduce a per-process float vector across ALL processes ("sum" or
+    "max"); returns the identical reduced vector on every process.
+
+    Each process contributes `vals` on its first local device and the
+    reduction identity (0 / -inf) on the rest, so device multiplicity
+    never double-counts a process. Works single-process too (the tests
+    exercise the device path directly); the public primitives
+    short-circuit before reaching here when there is nothing to agree.
+    """
+    row = np.asarray(vals, np.float32).reshape(-1)
+    fill = 0.0 if kind == "sum" else -np.inf
+    local = np.full((jax.local_device_count(), row.size), fill, np.float32)
+    local[0] = row
+    sharding = NamedSharding(_coord_mesh(), P(COORD_AXIS))
+    garr = jax.make_array_from_process_local_data(sharding, local)
+    return np.asarray(_reduce_prog(kind)(garr))
+
+
+# ---------------------------------------------------------------------------
+# agreement primitives
+# ---------------------------------------------------------------------------
+
+def agree_any(flag: bool) -> bool:
+    """True everywhere iff ANY process passed True (preempt drain: one
+    signaled host drains the whole group)."""
+    if process_count() == 1:
+        return bool(flag)
+    return bool(_device_reduce([1.0 if flag else 0.0], "sum")[0] > 0.0)
+
+
+def agree_all(flag: bool) -> bool:
+    """True everywhere iff EVERY process passed True (rollback: only when
+    every host can restore; autotune cache hit: only when every host has
+    the entry)."""
+    if process_count() == 1:
+        return bool(flag)
+    total = _device_reduce([1.0 if flag else 0.0], "sum")[0]
+    return bool(total >= float(process_count()))
+
+
+def broadcast_flag(value: float, source: int = 0) -> float:
+    """Process `source`'s scalar, identical everywhere (the tb-profile
+    broadcast pattern, for host decisions: restore-target steps,
+    agreed winner indices, ...)."""
+    if process_count() == 1:
+        return float(value)
+    contrib = float(value) if process_index() == source else 0.0
+    return float(_device_reduce([contrib], "sum")[0])
+
+
+def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
+    """Agreed argmin over per-candidate timings.
+
+    `values[i]` is this process's measured time for candidate i (None =
+    not measured here). Each candidate is reduced to its MAX across
+    processes — a synchronous group runs at its straggler's pace, and a
+    candidate unmeasured anywhere prices as +inf — then every process
+    computes the same argmin over the same reduced vector.
+
+    Returns (winner_index, reduced_times); reduced_times[winner] is
+    +inf iff NO candidate was measured on every process.
+    """
+    vals = [
+        float("inf") if v is None or not np.isfinite(v) else float(v)
+        for v in values
+    ]
+    if not vals:
+        raise ValueError("all_argmin: empty candidate list")
+    if process_count() > 1:
+        vals = [float(t) for t in _device_reduce(vals, "max")]
+    return int(np.argmin(vals)), vals
+
+
+# per-name use counters: barrier keys must be unique per rendezvous, and
+# every process mints the same sequence as long as its call order matches
+# (the same lockstep invariant every primitive here already requires)
+_barrier_seq: collections.Counter = collections.Counter()
+
+
+def barrier(name: str, timeout_s: Optional[float] = None) -> None:
+    """Named rendezvous across all processes, with a real timeout.
+
+    Uses the jax.distributed coordination-service barrier (timeout
+    enforced server-side); a missing client degrades to
+    `multihost_utils.sync_global_devices` under a thread deadline. A
+    timeout raises RuntimeError — the caller should treat the process
+    group as broken and exit so the supervisor can resubmit it.
+    """
+    if process_count() == 1:
+        return
+    if timeout_s is None:
+        raw = (os.environ.get(BARRIER_TIMEOUT_ENV) or "").strip()
+        if raw:
+            try:
+                timeout_s = float(raw)
+            except ValueError:
+                # a garbage value must fail with the variable named, not
+                # a bare float() traceback mid-drain
+                raise ValueError(
+                    f"{BARRIER_TIMEOUT_ENV}={raw!r} is not a number"
+                ) from None
+        else:
+            timeout_s = DEFAULT_BARRIER_TIMEOUT_S
+    key = f"mgwfbp:{name}:{_barrier_seq[name]}"
+    _barrier_seq[name] += 1
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+    except Exception:  # noqa: BLE001 — private module moved; use fallback
+        client = None
+    try:
+        if client is not None:
+            client.wait_at_barrier(key, int(timeout_s * 1000))
+        else:
+            from jax.experimental import multihost_utils
+
+            run_with_deadline(
+                lambda: multihost_utils.sync_global_devices(key),
+                timeout_s, what=f"barrier {name!r}",
+            )
+    except Exception as e:  # noqa: BLE001 — uniform failure surface
+        raise RuntimeError(
+            f"coordination barrier {name!r} failed after {timeout_s:.0f}s "
+            f"({e}); a peer process is dead or wedged — exiting so the "
+            "supervisor can tear down and resubmit the group"
+        ) from e

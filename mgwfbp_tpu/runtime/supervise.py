@@ -1,0 +1,82 @@
+"""`python -m mgwfbp_tpu.runtime.supervise` — launch a coordinated
+multi-process training group under the auto-resubmit supervisor.
+
+    python -m mgwfbp_tpu.runtime.supervise --processes 2 -- \
+        --dnn lenet --synthetic --telemetry --logdir logs \
+        --checkpoint-dir checkpoints --ckpt-every-steps 25
+
+Everything after ``--`` goes to `mgwfbp_tpu.train_cli` verbatim; the
+supervisor exports MGWFBP_COORDINATOR / MGWFBP_NUM_PROCESSES /
+MGWFBP_PROCESS_ID per child. Exit-code policy (README "Multi-host
+runtime"): rc 75 resubmits the whole group with bounded exponential
+backoff, rc 86 (watchdog abort) stops and points at the stack dumps,
+any other failure tears down the stragglers and propagates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mgwfbp-supervise",
+        description="multi-process training group supervisor "
+                    "(auto-resubmit on rc 75 / EX_TEMPFAIL)",
+    )
+    p.add_argument("--processes", type=int, required=True,
+                   help="process-group size (MGWFBP_NUM_PROCESSES)")
+    p.add_argument("--max-restarts", dest="max_restarts", type=int,
+                   default=3,
+                   help="resubmission budget for preempted (rc 75) groups")
+    p.add_argument("--backoff-base", dest="backoff_base", type=float,
+                   default=1.0,
+                   help="first resubmit delay in seconds (doubles per "
+                        "restart, capped by --backoff-max)")
+    p.add_argument("--backoff-max", dest="backoff_max", type=float,
+                   default=60.0)
+    p.add_argument("--grace", type=float, default=10.0,
+                   help="seconds between SIGTERM and SIGKILL when tearing "
+                        "down stragglers")
+    p.add_argument("--drain-grace", dest="drain_grace", type=float,
+                   default=120.0,
+                   help="seconds peers get to finish their agreed drain "
+                        "after the first rc-75 exit")
+    p.add_argument("--log-dir", dest="log_dir", default=None,
+                   help="capture each child's stdout+stderr to "
+                        "<log-dir>/p<idx>.i<incarnation>.log (default: "
+                        "inherit this terminal)")
+    p.add_argument("--port", type=int, default=None,
+                   help="coordinator port (default: pick a free one per "
+                        "incarnation)")
+    p.add_argument("train_args", nargs=argparse.REMAINDER,
+                   help="arguments for mgwfbp_tpu.train_cli (prefix "
+                        "with --)")
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    train_args = args.train_args
+    if train_args and train_args[0] == "--":
+        train_args = train_args[1:]
+    sup = Supervisor(
+        default_train_cmd(train_args),
+        args.processes,
+        max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base,
+        backoff_max_s=args.backoff_max,
+        grace_s=args.grace,
+        drain_grace_s=args.drain_grace,
+        log_dir=args.log_dir,
+        port=args.port,
+    )
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

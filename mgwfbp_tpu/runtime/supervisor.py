@@ -1,0 +1,296 @@
+"""Process-group supervisor: launch, watch, resubmit.
+
+The resilience layer (PR 5) made a preempted training process exit rc 75
+(EX_TEMPFAIL) after draining to a step-indexed checkpoint — but nothing
+restarted it, so "preemption-safe" ended at the process boundary. This
+module closes the loop for a LOCAL multi-process group (one host driving
+N coordinated processes; on a real pod each host runs its own train_cli
+under the cluster's scheduler and only the rc contract below applies):
+
+  rc 0   (all)   the run finished; exit 0.
+  rc 75  (any)   graceful preemption drain: progress is checkpointed and
+                 the whole group agreed to exit (runtime/coordination) —
+                 resubmit the ENTIRE group after bounded exponential
+                 backoff, until the restart budget is spent.
+  rc 86  (any)   watchdog abort: a wedged device/runtime; the aborting
+                 process faulthandler-dumped every thread's stack first.
+                 Restarting a wedged grant loops forever, so STOP and
+                 surface where the dumps are.
+  other  (any)   a real failure: tear down the stragglers (SIGTERM,
+                 grace, SIGKILL) and exit with the failing rc.
+
+Launch contract (what each child sees): MGWFBP_COORDINATOR,
+MGWFBP_NUM_PROCESSES, MGWFBP_PROCESS_ID — the env chain train_cli's
+`resolve_multihost` reads. Everything else (fault plans, platform
+overrides) is inherited, so `MGWFBP_FAULT_PLAN='preempt@step=4,proc=1'`
+preempts exactly one process of the group and exercises the agreed
+drain end to end.
+
+`python -m mgwfbp_tpu.runtime.supervise --processes 2 -- <train args>`
+is the CLI (see runtime/supervise.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+from mgwfbp_tpu.utils.faults import PREEMPT_RC
+from mgwfbp_tpu.utils.logging import get_logger
+
+# utils/watchdog.py exits the process with os._exit(86) after dumping all
+# thread stacks; keep in sync (the watchdog predates this constant)
+WATCHDOG_RC = 86
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclasses.dataclass
+class GroupResult:
+    """Outcome of one incarnation of the process group."""
+
+    incarnation: int
+    returncodes: list[int]
+
+    @property
+    def ok(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+    @property
+    def preempted(self) -> bool:
+        """Restart-friendly: at least one drain, nothing worse."""
+        return (
+            any(rc == PREEMPT_RC for rc in self.returncodes)
+            and all(rc in (0, PREEMPT_RC) for rc in self.returncodes)
+        )
+
+    @property
+    def watchdog_abort(self) -> bool:
+        return any(rc == WATCHDOG_RC for rc in self.returncodes)
+
+
+class Supervisor:
+    """Launch a coordinated N-process group and apply the rc policy.
+
+    `base_cmd` is the per-process command (default: this interpreter's
+    train_cli); process index, count, and coordinator land in the child
+    ENV, not argv, so the same command line serves every slot and every
+    incarnation. Injectable `sleep` keeps the backoff testable.
+    """
+
+    def __init__(
+        self,
+        base_cmd: Sequence[str],
+        processes: int,
+        *,
+        max_restarts: int = 3,
+        backoff_base_s: float = 1.0,
+        backoff_max_s: float = 60.0,
+        grace_s: float = 10.0,
+        drain_grace_s: float = 120.0,
+        log_dir: Optional[str] = None,
+        env: Optional[dict] = None,
+        port: Optional[int] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if processes < 1:
+            raise ValueError(f"processes must be >= 1, got {processes}")
+        self.base_cmd = list(base_cmd)
+        self.processes = int(processes)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.grace_s = float(grace_s)
+        self.drain_grace_s = float(drain_grace_s)
+        self.log_dir = log_dir
+        self.env = dict(env if env is not None else os.environ)
+        self.port = port
+        self.sleep = sleep
+        self.log = get_logger("mgwfbp.supervisor")
+        self.results: list[GroupResult] = []
+
+    # -- launch ------------------------------------------------------------
+    def _child_env(self, idx: int, port: int) -> dict:
+        env = dict(self.env)
+        env["MGWFBP_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["MGWFBP_NUM_PROCESSES"] = str(self.processes)
+        env["MGWFBP_PROCESS_ID"] = str(idx)
+        return env
+
+    def _spawn(self, idx: int, incarnation: int, port: int):
+        stdout = stderr = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(
+                self.log_dir, f"p{idx}.i{incarnation}.log"
+            )
+            stdout = open(path, "w", buffering=1)
+            stderr = subprocess.STDOUT
+        return subprocess.Popen(
+            self.base_cmd,
+            env=self._child_env(idx, port),
+            stdout=stdout,
+            stderr=stderr,
+        ), stdout
+
+    def _run_group(self, incarnation: int) -> GroupResult:
+        port = self.port if self.port is not None else free_port()
+        self.log.info(
+            "incarnation %d: launching %d process(es) (coordinator "
+            "127.0.0.1:%d)", incarnation, self.processes, port,
+        )
+        procs, logs = [], []
+        for i in range(self.processes):
+            p, f = self._spawn(i, incarnation, port)
+            procs.append(p)
+            logs.append(f)
+        try:
+            rcs = self._watch(procs)
+        finally:
+            for f in logs:
+                if f is not None:
+                    f.close()
+        result = GroupResult(incarnation, rcs)
+        self.results.append(result)
+        self.log.info(
+            "incarnation %d: exit codes %s", incarnation, rcs,
+        )
+        return result
+
+    def _watch(self, procs) -> list[int]:
+        """Poll until every process exits; once ANY process exits,
+        stragglers get a bounded window before teardown. A group member
+        that outlives its peers is wedged — once a peer is gone its next
+        collective can never complete (a clean rc-0 exit takes the
+        coordination service down just as surely as a crash) — so
+        waiting forever would hang the supervisor exactly the way the
+        job hung."""
+        deadline = None  # armed on the first exit of any kind
+        grace = None
+        while True:
+            pending = [p for p in procs if p.poll() is None]
+            if not pending:
+                return [int(p.returncode) for p in procs]
+            done = [p.returncode for p in procs if p.returncode is not None]
+            if done and deadline is None:
+                # rc 0/75: peers are finishing up or drain-agreeing and
+                # checkpointing — give them the drain window. Anything
+                # else: the group is already broken; short fuse.
+                grace = (
+                    self.drain_grace_s
+                    if all(rc in (0, PREEMPT_RC) for rc in done)
+                    else self.grace_s
+                )
+                deadline = time.monotonic() + grace
+            if deadline is not None and time.monotonic() > deadline:
+                self.log.warning(
+                    "tearing down %d straggler(s) %.0fs after first "
+                    "failure", len(pending), grace,
+                )
+                self._teardown(pending)
+                return [
+                    int(p.returncode) if p.returncode is not None else -9
+                    for p in procs
+                ]
+            time.sleep(0.05)
+
+    def _teardown(self, procs) -> None:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        t0 = time.monotonic()
+        while any(p.poll() is None for p in procs):
+            if time.monotonic() - t0 > self.grace_s:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                return
+            time.sleep(0.05)
+
+    # -- policy ------------------------------------------------------------
+    def backoff_s(self, restart: int) -> float:
+        """Bounded exponential: base * 2^(restart-1), capped."""
+        return min(
+            self.backoff_base_s * (2.0 ** max(restart - 1, 0)),
+            self.backoff_max_s,
+        )
+
+    def run(self) -> int:
+        restarts = 0
+        incarnation = 0
+        while True:
+            result = self._run_group(incarnation)
+            if result.ok:
+                if restarts:
+                    self.log.info(
+                        "group completed after %d resubmission(s)", restarts,
+                    )
+                return 0
+            if result.watchdog_abort:
+                where = (
+                    f" (per-process logs under {self.log_dir})"
+                    if self.log_dir else " (see the group's stderr)"
+                )
+                self.log.error(
+                    "watchdog abort (rc %d): a process dumped all thread "
+                    "stacks before exiting%s. A wedged device grant does "
+                    "not heal on restart — NOT resubmitting.",
+                    WATCHDOG_RC, where,
+                )
+                return WATCHDOG_RC
+            if not result.preempted:
+                bad = [
+                    rc for rc in result.returncodes
+                    if rc not in (0, PREEMPT_RC)
+                ]
+                self.log.error(
+                    "group failed (exit codes %s); stragglers torn down, "
+                    "not resubmitting", result.returncodes,
+                )
+                # prefer a child's real rc over a signal-killed straggler's
+                # negative Popen code; a pure-signal group maps to the
+                # conventional 128+signal so the shell status stays honest
+                pos = [rc for rc in bad if rc > 0]
+                if pos:
+                    return pos[0]
+                return 128 + abs(bad[0]) if bad else 1
+            if restarts >= self.max_restarts:
+                self.log.error(
+                    "preempted again but the restart budget (%d) is "
+                    "spent; progress is checkpointed — resubmit manually "
+                    "or raise --max-restarts", self.max_restarts,
+                )
+                return PREEMPT_RC
+            restarts += 1
+            delay = self.backoff_s(restarts)
+            self.log.warning(
+                "group preempted (rc %d): resubmitting in %.1fs "
+                "(restart %d/%d) — resumed run restores from the drained "
+                "checkpoint", PREEMPT_RC, delay, restarts,
+                self.max_restarts,
+            )
+            self.sleep(delay)
+            incarnation += 1
+
+
+def default_train_cmd(train_args: Sequence[str]) -> list[str]:
+    """The per-process command for a training group: this interpreter,
+    this repo's launcher, the user's args verbatim."""
+    return [sys.executable, "-m", "mgwfbp_tpu.train_cli", *train_args]

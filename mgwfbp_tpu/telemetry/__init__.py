@@ -13,8 +13,10 @@ from mgwfbp_tpu.telemetry.events import (
     EVENT_TYPES,
     EventWriter,
     events_of,
+    find_stream_paths,
     read_event_set,
     read_events,
+    stream_filename,
 )
 from mgwfbp_tpu.telemetry.overlap import (
     GroupOverlap,
@@ -29,8 +31,10 @@ __all__ = [
     "EVENT_TYPES",
     "EventWriter",
     "events_of",
+    "find_stream_paths",
     "read_event_set",
     "read_events",
+    "stream_filename",
     "GroupOverlap",
     "OverlapSummary",
     "attribute_overlap",

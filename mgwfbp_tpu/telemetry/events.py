@@ -97,6 +97,44 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
 _JSON_SCALARS = (str, int, float, bool, type(None))
 
 
+def stream_filename(process_index: int = 0, process_count: int = 1) -> str:
+    """Per-run stream file name. Single-process runs keep the historical
+    ``telemetry.jsonl``; a multi-host group writes one stream PER PROCESS
+    (``telemetry.pN.jsonl``, process_index/process_count in the header's
+    run metadata) — `tools/telemetry_merge.py` reassembles the global
+    timeline. One convention, shared by the trainer and the merge tool."""
+    if process_count <= 1:
+        return "telemetry.jsonl"
+    return f"telemetry.p{int(process_index)}.jsonl"
+
+
+def find_stream_paths(directory: str) -> list[str]:
+    """Active stream files under `directory` (single- or multi-process
+    naming), process order. Rotated ``.NNNN`` segments are NOT listed —
+    `read_event_set` on an active path folds its segments in."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if name == "telemetry.jsonl":
+            out.append((-1, name))
+        elif name.startswith("telemetry.p") and name.endswith(".jsonl"):
+            idx = name[len("telemetry.p"):-len(".jsonl")]
+            if idx.isdigit():
+                out.append((int(idx), name))
+    multi = [e for e in out if e[0] >= 0]
+    if multi:
+        # a multi-host group never writes the single-process name, so a
+        # telemetry.jsonl sitting next to pN streams is a stale earlier
+        # single-host run of the same (deterministic) tag — listing it
+        # would silently interleave two different runs' timelines in the
+        # merge
+        out = multi
+    return [os.path.join(directory, n) for _, n in sorted(out)]
+
+
 def _check_jsonable(value, key: str) -> None:
     """Reject anything that is not already host-side JSON data.
 

@@ -40,6 +40,8 @@ from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
 from mgwfbp_tpu.parallel.costmodel import load_profile, lookup_alpha_beta
 from mgwfbp_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, MeshSpec, make_mesh
 from mgwfbp_tpu.profiling import benchmark_trainer_backward
+from mgwfbp_tpu.runtime import ResizeUnsupported
+from mgwfbp_tpu.runtime import coordination as coord
 from mgwfbp_tpu.train.step import (
     create_train_state,
     make_eval_step,
@@ -223,10 +225,26 @@ class Trainer:
         self.autotune_report = None  # set by autotune() (cache hit or race)
         # resilience layer (ISSUE 5): deterministic fault plan, graceful
         # preemption drain, non-finite-step bookkeeping, mid-epoch resume
-        self._faults = FaultPlan.from_env()
+        self._faults = FaultPlan.from_env().for_process(jax.process_index())
         if self._faults:
             self.log.info("fault plan armed: %s", self._faults.describe())
         self._preempt_signal: Optional[str] = None
+        # multi-host: how often (in optimizer steps) the group runs the
+        # tiny agree_any collective that turns ONE host's preemption
+        # signal into a GROUP drain. Every step by default (drain latency
+        # = 1 step); the collective syncs the dispatch pipeline, so
+        # latency-sensitive real-chip runs raise it — drain then lags by
+        # at most N steps. Must be identical across the group (the
+        # supervisor exports one env); single-host runs never consult it.
+        raw_interval = (
+            os.environ.get("MGWFBP_AGREE_INTERVAL") or ""
+        ).strip()
+        try:
+            self._agree_interval = max(int(raw_interval or "1"), 1)
+        except ValueError:
+            raise ValueError(
+                f"MGWFBP_AGREE_INTERVAL={raw_interval!r} is not an integer"
+            ) from None
         self._signals_armed = False
         self._resume_epoch: Optional[int] = None  # mid-epoch resume target
         self._resume_skip_steps = 0  # optimizer steps already done there
@@ -395,26 +413,31 @@ class Trainer:
         old_tel = getattr(self, "telemetry", None)
         if old_tel is not None:
             old_tel.close()
-        # telemetry event stream (telemetry/events.py): process 0 only,
-        # one schema-versioned JSONL per tagged run — step spans, overlap
-        # snapshots, resizes, checkpoints, watchdog stalls all land here
+        # telemetry event stream (telemetry/events.py): one schema-
+        # versioned JSONL PER PROCESS per tagged run (single-process keeps
+        # the historical telemetry.jsonl name) — step spans, overlap
+        # snapshots, resizes, checkpoints, watchdog stalls all land here;
+        # tools/telemetry_merge.py reassembles a multi-host group's
+        # streams into one global timeline + straggler table
         self.telemetry = None
         tel_dir = config.telemetry_dir or (
             os.path.join(config.logdir, config.tag())
             if config.logdir
             else None
         )
-        if config.telemetry and jax.process_index() == 0:
+        if config.telemetry:
             if tel_dir is None:
                 self.log.warning(
                     "--telemetry requested but neither --telemetry-dir nor "
                     "--logdir is set; telemetry disabled"
                 )
             else:
-                from mgwfbp_tpu.telemetry import EventWriter
+                from mgwfbp_tpu.telemetry import EventWriter, stream_filename
 
                 self.telemetry = EventWriter(
-                    os.path.join(tel_dir, "telemetry.jsonl"),
+                    os.path.join(tel_dir, stream_filename(
+                        jax.process_index(), jax.process_count()
+                    )),
                     run={
                         "model": config.dnn,
                         "dataset": config.dataset,
@@ -422,6 +445,8 @@ class Trainer:
                         "comm_op": config.comm_op,
                         "policy": config.policy,
                         "tag": config.tag(),
+                        "process_index": jax.process_index(),
+                        "process_count": jax.process_count(),
                     },
                 )
         # scalar event stream (reference's tensorboardX seam, live):
@@ -634,17 +659,21 @@ class Trainer:
         if nworkers == self.data_size:
             return
         if self.dcn_size > 1:
-            raise NotImplementedError(
-                "update_nworker on a multi-slice (dcn) mesh is not "
-                "supported; relaunch with new --dcn-slices instead"
+            raise ResizeUnsupported(
+                "update_nworker cannot re-mesh a multi-slice (dcn) run in "
+                "place; relaunch with new --dcn-slices",
+                nworkers,
             )
         if jax.process_count() > 1:
-            # Cross-host elastic resize needs a coordinated device subset on
-            # every host plus loader re-ranking — out of scope, exactly as in
-            # the reference where update_nworker has no distributed caller.
-            raise NotImplementedError(
-                "update_nworker supports single-process (multi-device) runs; "
-                "multi-host resize requires relaunching with a new process set"
+            # Cross-host elastic resize needs a coordinated device subset
+            # on every host plus loader re-ranking; the SUPPORTED path is
+            # resize-by-relaunch — drain, then relaunch the whole group at
+            # the new size under the supervisor (the structured error
+            # carries the recipe; README "Multi-host runtime").
+            raise ResizeUnsupported(
+                "update_nworker supports single-process (multi-device) "
+                "runs; a multi-host process group cannot re-mesh in place",
+                nworkers,
             )
         n_devices = nworkers * self.seq_size
         avail = len(jax.devices())
@@ -773,17 +802,17 @@ class Trainer:
             )
             return None
         if jax.process_count() > 1:
-            # every process would time candidates with its own wall clock
-            # and refit its own model; two hosts committing different
-            # schedules issue mismatched collectives -> distributed hang.
-            # The race needs a broadcast-agreed argmin (like tb in
-            # _profile_backward) — ROADMAP follow-up; refuse until then.
-            self.log.warning(
-                "autotune: skipped on multi-host runs (per-process timings "
-                "could commit divergent schedules); tune single-host and "
-                "ship the cache entry instead"
+            # multi-host race protocol (ISSUE 6): candidates derive from
+            # broadcast-identical inputs (tb, cost model, layer specs), so
+            # every process races the SAME sequence of schedules in
+            # lockstep; only the WALL-CLOCK timings are per-process. Those
+            # are reduced to one agreed vector (each candidate at its
+            # slowest process — coordination.all_argmin) before anything
+            # commits, so divergent schedules can never be installed.
+            self.log.info(
+                "autotune: multi-host race — per-candidate timings will "
+                "be reduced to a cross-process argmin before commit"
             )
-            return None
         world = self.data_size * self.seq_size
         cache_dir = cfg.schedule_cache or os.path.join(
             "profiles", "schedule_cache"
@@ -797,7 +826,16 @@ class Trainer:
         path = at.entry_path(cache_dir, key)
         entry = at.load_cache_entry(path)
         names_now = list(self.reducer.schedule.layer_names)
-        if entry is not None and entry.get("layer_names") == names_now:
+        cache_hit = (
+            entry is not None and entry.get("layer_names") == names_now
+        )
+        if coord.process_count() > 1:
+            # the cache is filesystem state: without a shared FS one host
+            # can hold the entry while another misses. A split decision is
+            # a split schedule, so the hit counts only when EVERY process
+            # has it; otherwise all re-race together.
+            cache_hit = coord.agree_all(cache_hit)
+        if cache_hit:
             groups = tuple(tuple(int(i) for i in g) for g in entry["groups"])
             if not self._reducer_is_live(groups, entry["comm_op"]):
                 self._swap_reducer(self._reducer_for(
@@ -889,6 +927,11 @@ class Trainer:
             # side would re-race an already-timed schedule
             raced_shapes.add((c.comm_op, tuple(map(tuple, c.groups))))
             raced_shapes.add((e.comm_op, tuple(map(tuple, e.groups))))
+        # multi-host: per-process wall clocks disagree; reduce every
+        # candidate's timing to the group-agreed value (its slowest
+        # process) BEFORE anything downstream reads them, so the refit
+        # inputs and the argmin are identical everywhere
+        self._sync_entry_times(entries)
 
         # ---- refit from observations + one re-solve ------------------
         refit_info = None
@@ -950,9 +993,11 @@ class Trainer:
                         entries.append(self._race_candidate(
                             cand, batch_iter, sample_batch, steps
                         ))
-                    timed = [
-                        e for e in entries if e.measured_step_s is not None
-                    ]
+        # the refit re-solve may have raced one more candidate; agree on
+        # its timing too before the winner is chosen (idempotent for the
+        # already-reduced entries, no-op single-process)
+        self._sync_entry_times(entries)
+        timed = [e for e in entries if e.measured_step_s is not None]
 
         # ---- commit the measured argmin + persist --------------------
         if not timed:
@@ -1002,7 +1047,10 @@ class Trainer:
             ],
             "measured_group_times": measured_groups,
         }
-        at.save_cache_entry(path, cache_entry)
+        if coord.is_primary():
+            # one writer: the cache file is shared state (and on a shared
+            # FS two processes racing the rename could tear it)
+            at.save_cache_entry(path, cache_entry)
         # trace-attributed group times (when the backend supplied any)
         # describe the NOW-LIVE winner; hand them to the overlap accounting
         self._measured_group_times = (
@@ -1036,6 +1084,25 @@ class Trainer:
             },
         }
         return self.autotune_report
+
+    def _sync_entry_times(self, entries) -> None:
+        """Multi-host: replace each race entry's measured step time with
+        the group-agreed value — the MAX across processes (a synchronous
+        group runs at its straggler's pace), with unmeasured-anywhere
+        reducing to None — so every process's `min(timed)` argmin, refit
+        observations, and cache entry are bitwise identical. No-op
+        single-process and on an empty race."""
+        if coord.process_count() == 1 or not entries:
+            return
+        idx, reduced = coord.all_argmin(
+            [e.measured_step_s for e in entries]
+        )
+        for e, t in zip(entries, reduced):
+            e.measured_step_s = float(t) if np.isfinite(t) else None
+        self.log.info(
+            "autotune: cross-process argmin -> candidate %d (%s)",
+            idx, entries[idx].label,
+        )
 
     def _reducer_for(self, groups, comm_op: str, detail: str = ""):
         """A MergedAllreduce for an EXPLICIT grouping (autotune candidates,
@@ -1297,14 +1364,26 @@ class Trainer:
             jax.block_until_ready(self.state)
 
         measured = None
-        try:
-            measured = trace_group_times(run, num_groups, iters=iters)
-            self.iteration += iters
-        except Exception as e:  # noqa: BLE001 — profiling must never kill
-            # the tuning phase; the step-delta fallback still applies
+        if coord.process_count() > 1:
+            # per-process profiler traces diverge (attribution is
+            # backend/host dependent), and a divergent refit means a
+            # divergent re-solve -> mismatched collectives. The step-delta
+            # fallback reads the group-AGREED entry times instead, so the
+            # refit is identical everywhere by construction.
             self.log.info(
-                "autotune: group trace failed (%s); using step deltas", e
+                "autotune: multi-host — trace attribution skipped, "
+                "refitting from agreed step deltas"
             )
+        else:
+            try:
+                measured = trace_group_times(run, num_groups, iters=iters)
+                self.iteration += iters
+            except Exception as e:  # noqa: BLE001 — profiling must never
+                # kill the tuning phase; the step-delta fallback applies
+                self.log.info(
+                    "autotune: group trace failed (%s); using step deltas",
+                    e,
+                )
         if measured is not None and num_groups >= 2:
             layout = self.reducer.layout
             nbytes = [
@@ -1757,7 +1836,7 @@ class Trainer:
             sig = self._faults.preempt_signal_after(self.iteration)
             if sig is not None:
                 self._deliver_preempt(sig)
-            if self._preempt_signal is not None:
+            if self._agreed_preempt():
                 self._graceful_drain(epoch, epoch_pos)  # raises Preempted
             if max_steps is not None and epoch_pos >= max_steps:
                 break
@@ -1885,6 +1964,29 @@ class Trainer:
             self.log.warning("fault injection: simulating %s", name)
             self._preempt_signal = name
 
+    def _agreed_preempt(self, at_boundary: bool = False) -> bool:
+        """Should the WHOLE group drain now?
+
+        Single-process: the local flag, checked every step (today's
+        behavior). Multi-host: one host's SIGTERM must drain every
+        process — whoever keeps stepping blocks forever in its next
+        collective against peers that left — so the group runs a tiny
+        `agree_any` collective over the local flags. It runs at
+        deterministic points only (every `_agree_interval`-th step, and
+        at epoch boundaries): agreement participation may NEVER depend on
+        the local flag itself, or the signaled process would issue a
+        collective its peers don't. A process drained by a peer's signal
+        records the drain as signal 'PEER'."""
+        local = self._preempt_signal is not None
+        if coord.process_count() == 1:
+            return local
+        if not at_boundary and self.iteration % self._agree_interval != 0:
+            return False
+        agreed = coord.agree_any(local)
+        if agreed and not local:
+            self._preempt_signal = "PEER"  # drained by a peer's signal
+        return agreed
+
     def _graceful_drain(self, epoch: int, epoch_pos: int) -> None:
         """The in-flight step is done; checkpoint the exact position and
         unwind with Preempted (train_cli converts it to rc 75)."""
@@ -1986,10 +2088,21 @@ class Trainer:
         limit = self.config.bad_step_limit
         if not limit or self._bad_streak < limit:
             return
-        if (
+        can_rollback = (
             self.checkpointer is not None
             and self.checkpointer.latest_step() is not None
-        ):
+        )
+        if coord.process_count() > 1:
+            # the streak itself is identical everywhere (the nonfinite
+            # count rides the globally-psum'd metrics and the guard
+            # cadence is deterministic), so every process reaches this
+            # point at the same step — but whether a checkpoint EXISTS is
+            # host-local state (e.g. a host with a torn local dir). One
+            # process rolling back while another keeps stepping is a
+            # distributed hang, so the group agrees: roll back only when
+            # EVERY process can.
+            can_rollback = coord.agree_all(can_rollback)
+        if can_rollback:
             raise _RollbackRequested(self._bad_streak)
         if not getattr(self, "_warned_no_rollback", False):
             self._warned_no_rollback = True
@@ -2002,8 +2115,18 @@ class Trainer:
     def _rollback(self, rb: _RollbackRequested) -> int:
         """Restore the last checkpoint after K consecutive bad steps;
         returns the epoch to continue from."""
+        step = self.checkpointer.latest_step()
+        if coord.process_count() > 1:
+            # every process must replay from the SAME snapshot; latest_step
+            # is host-local filesystem state, so process 0's choice is the
+            # group's choice (broadcast, like the tb profile)
+            step = int(coord.broadcast_flag(
+                float(step if step is not None else -1)
+            ))
+            step = None if step < 0 else step
         snap = self.checkpointer.restore(
             self._replicated_template_state(),
+            step=step,
             carry_template=self._carry_template(),
         )
         if snap is None:  # GC'd between check and restore — give up cleanly
@@ -2241,9 +2364,23 @@ class Trainer:
             return
         carry = None
         if self.meta.has_carry and self.carry is not None:
-            # host-materialize: the live carry is sharded over the data
-            # axis; the checkpoint form must be layout-independent
-            carry = jax.tree_util.tree_map(np.asarray, self.carry)
+            if jax.process_count() > 1:
+                # the live carry is data-sharded across PROCESSES: no one
+                # process can materialize the layout-independent host
+                # form. Resume re-initializes the epoch's hidden state
+                # instead (ROADMAP names the carry-allgather follow-up);
+                # params/opt state stay exact. Warn once, not per save.
+                if not getattr(self, "_warned_no_carry_ckpt", False):
+                    self._warned_no_carry_ckpt = True
+                    self.log.warning(
+                        "multi-host: BPTT carry not checkpointed; a "
+                        "resume restarts this epoch's hidden state from "
+                        "zeros"
+                    )
+            else:
+                # host-materialize: the live carry is sharded over the
+                # data axis; the checkpoint form must be layout-independent
+                carry = jax.tree_util.tree_map(np.asarray, self.carry)
         self.checkpointer.save(
             Snapshot(
                 state=self._to_checkpoint_state(self.state),
@@ -2274,8 +2411,6 @@ class Trainer:
         is the reference's post-load broadcast_parameters,
         dist_trainer.py:66, expressed as a sharding constraint). Returns the
         Snapshot; raises if none exists."""
-        from jax.sharding import NamedSharding, PartitionSpec
-
         ckpt = Checkpointer(directory)
         try:
             snap = ckpt.restore(
@@ -2289,10 +2424,26 @@ class Trainer:
                 f"no checkpoint found under {directory!r}"
                 + (f" at epoch {epoch}" if epoch is not None else "")
             )
-        snap.state = jax.device_put(
-            snap.state, NamedSharding(self.mesh, PartitionSpec())
-        )
+        snap.state = self._replicate_onto_mesh(snap.state)
         return snap
+
+    def _replicate_onto_mesh(self, tree):
+        """Restored host/local-device leaves -> replicated arrays on the
+        live mesh. Single-process this is the plain device_put; on a
+        multi-host mesh device_put rejects non-addressable shardings, so
+        each process contributes its (identical) local copy and jax
+        assembles the global replicated array."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(self.mesh, PartitionSpec())
+        if jax.process_count() == 1:
+            return jax.device_put(tree, sharding)
+        return jax.tree_util.tree_map(
+            lambda a: jax.make_array_from_process_local_data(
+                sharding, np.asarray(a)
+            ),
+            tree,
+        )
 
     def _carry_template(self):
         """Restore template for a checkpointed BPTT carry (host form)."""
@@ -2313,12 +2464,8 @@ class Trainer:
         emits its own `rollback` record, and a `resume` row means "a
         restart picked up from a saved snapshot", which a rollback inside
         one uninterrupted process is not)."""
-        from jax.sharding import NamedSharding, PartitionSpec
-
         self.state = self._from_checkpoint_state(
-            jax.device_put(
-                snap.state, NamedSharding(self.mesh, PartitionSpec())
-            )
+            self._replicate_onto_mesh(snap.state)
         )
         self.iteration = snap.iteration
         if snap.mid_epoch:
@@ -2405,10 +2552,9 @@ class Trainer:
                     self.autotune()
                 if (
                     self.telemetry is not None
-                    # single-process only: the traced steps issue REAL
-                    # collectives, and the telemetry writer exists only on
-                    # process 0 — gating the steps on it would advance one
-                    # process ahead of the others (distributed hang)
+                    # single-process only: per-process traces diverge and
+                    # the traced steps sync the device — on a group the
+                    # overlap accounting stays on the cost model instead
                     and jax.process_count() == 1
                     and self._measured_group_times is None
                     and os.environ.get("MGWFBP_TELEMETRY_TRACE") == "1"
@@ -2461,7 +2607,7 @@ class Trainer:
                     wd.beat(f"checkpoint epoch {epoch}",
                             allow_s=CHECKPOINT_ALLOW_S)
                 self.save(epoch)
-            if self._preempt_signal is not None:
+            if self._agreed_preempt(at_boundary=True):
                 # the signal landed outside the step loop (eval or
                 # checkpoint phase); drain at the epoch boundary
                 self._graceful_drain_boundary(epoch)

@@ -174,6 +174,63 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     return make_config(args.dnn, **overrides)
 
 
+_LAUNCH_CHAIN = (
+    "resolution chain: --coordinator/--num-processes/--process-id flags "
+    "> MGWFBP_COORDINATOR/MGWFBP_NUM_PROCESSES/MGWFBP_PROCESS_ID "
+    "> SLURM_NTASKS/SLURM_PROCID > OMPI_COMM_WORLD_SIZE/"
+    "OMPI_COMM_WORLD_RANK; `python -m mgwfbp_tpu.runtime.supervise` "
+    "exports the full MGWFBP_* contract for local process groups"
+)
+
+
+def resolve_multihost(
+    args: argparse.Namespace, environ: Optional[dict] = None,
+) -> tuple[Optional[str], Optional[int], Optional[int]]:
+    """(coordinator, num_processes, process_id) from the launcher
+    fallback chain: explicit flags, then the env chain owned by
+    `parallel.mesh.resolve_launch_env` (MGWFBP_* — the supervisor's
+    launch contract — then SLURM, then OpenMPI). All-None means a
+    single-host launch. A multi-host signal that cannot be completed
+    (num_processes > 1 but no coordinator or process id resolvable)
+    exits with the recipe instead of handing a half-configured launch to
+    jax.distributed (whose failure surfaces as a backend-probe traceback
+    or a silent hang)."""
+    from mgwfbp_tpu.parallel.mesh import resolve_launch_env
+
+    try:
+        env_coord, env_num, env_pid = resolve_launch_env(
+            os.environ if environ is None else environ
+        )
+    except ValueError as e:  # garbage env int -> clean CLI failure
+        raise SystemExit(str(e)) from None
+    coordinator = args.coordinator or env_coord
+    num = (
+        args.num_processes
+        if args.num_processes is not None
+        else env_num
+    )
+    pid = args.process_id if args.process_id is not None else env_pid
+    if coordinator is None and pid is None and (num is None or num <= 1):
+        return None, None, None  # single-host
+    missing = []
+    if num is None:
+        missing.append("worker count (--num-processes / "
+                       "MGWFBP_NUM_PROCESSES)")
+    if num is not None and num > 1:
+        if coordinator is None:
+            missing.append("coordinator address (--coordinator / "
+                           "MGWFBP_COORDINATOR, host:port)")
+        if pid is None:
+            missing.append("process id (--process-id / MGWFBP_PROCESS_ID "
+                           "/ launcher rank env)")
+    if missing:
+        raise SystemExit(
+            "multi-host launch signaled but incomplete — missing "
+            + "; ".join(missing) + ". " + _LAUNCH_CHAIN
+        )
+    return coordinator, num, pid
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
@@ -185,39 +242,28 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
 
     apply_platform_overrides()
-    env_procs = os.environ.get("MGWFBP_NUM_PROCESSES", "").strip()
-    try:
-        # =1 is a single-host launch: init_distributed ignores it (its own
-        # `num_processes <= 1` check), so treating it as a multi-host
-        # signal here would only skip the preflight probe (ADVICE r5 #1);
-        # empty stays single-host, garbage fails HERE with a clear message
-        # instead of deep inside init_distributed
-        env_multi = bool(env_procs) and int(env_procs) > 1
-    except ValueError:
-        raise SystemExit(
-            f"MGWFBP_NUM_PROCESSES={env_procs!r} is not an integer"
-        ) from None
+    coordinator, num_processes, process_id = resolve_multihost(args)
+    # any explicit distributed signal skips the probe: initialize() must
+    # be the first backend touch on every process of a group
     multi_host = bool(
-        args.coordinator
-        or args.num_processes
-        or args.process_id is not None
-        or env_multi
+        coordinator is not None
+        or process_id is not None
+        or (num_processes or 0) > 1
     )
     if not multi_host:
         # fail fast on a wedged device grant instead of hanging in PJRT
         # init (MGWFBP_INIT_TIMEOUT_S tunes/disables). Single-process
         # only: jax.distributed.initialize() must run before any backend
-        # touch, so every multi-host signal init_distributed honours
-        # (flags OR the MGWFBP_NUM_PROCESSES env) skips the probe — there
+        # touch, so a resolved multi-host launch skips the probe — there
         # the coordinator barrier itself surfaces a dead host.
         preflight_backend()
     from mgwfbp_tpu.parallel.mesh import init_distributed
     from mgwfbp_tpu.train.trainer import Trainer
 
     init_distributed(
-        coordinator_address=args.coordinator,
-        num_processes=args.num_processes,
-        process_id=args.process_id,
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
     )
     trainer = Trainer(
         cfg,

@@ -32,6 +32,13 @@ Plan grammar (``MGWFBP_FAULT_PLAN``)::
                                 unavailable (bench.py's ChipUnavailable
                                 structured-skip path)
 
+Every kind additionally takes ``proc=I``: the spec fires only on the
+process with that index (multi-host runs share one MGWFBP_FAULT_PLAN env
+across the group; ``preempt@step=4,proc=1`` preempts exactly one host so
+the agreed group drain is what gets exercised). The trainer applies the
+filter via ``FaultPlan.for_process``; a plan without ``proc=`` fires on
+every process, exactly as before.
+
 Everything is keyed on deterministic host counters — no randomness — so a
 faulted run is exactly reproducible, and a resumed run whose iteration
 counter is already past a fault's step does not re-fire it.
@@ -75,10 +82,10 @@ class Preempted(RuntimeError):
 
 KINDS = ("nan", "stall", "preempt", "chip_unavailable")
 _ALLOWED_KEYS = {
-    "nan": {"step", "count"},
-    "stall": {"secs", "phase", "step"},
-    "preempt": {"step", "signal"},
-    "chip_unavailable": set(),
+    "nan": {"step", "count", "proc"},
+    "stall": {"secs", "phase", "step", "proc"},
+    "preempt": {"step", "signal", "proc"},
+    "chip_unavailable": {"proc"},
 }
 _REQUIRED_KEYS = {
     "nan": {"step"},
@@ -105,6 +112,7 @@ class FaultSpec:
     secs: float = 0.0
     phase: str = "train"
     signal: str = "SIGTERM"
+    proc: Optional[int] = None  # None = fire on every process
     fired: bool = False  # one-shot kinds (stall/preempt) consume themselves
     fired_steps: set = dataclasses.field(default_factory=set)  # nan kind
     observed_below: bool = False  # preempt: a step < `step` was seen, so
@@ -122,6 +130,8 @@ class FaultSpec:
             kv.append(f"phase={self.phase}")
         if self.kind == "preempt":
             kv.append(f"signal={self.signal}")
+        if self.proc is not None:
+            kv.append(f"proc={self.proc}")
         return self.kind + ("@" + ",".join(kv) if kv else "")
 
 
@@ -170,10 +180,14 @@ def parse_plan(text: str) -> "FaultPlan":
                 spec.count = int(kv["count"])
             if "secs" in kv:
                 spec.secs = float(kv["secs"])
+            if "proc" in kv:
+                spec.proc = int(kv["proc"])
         except ValueError:
             raise ValueError(
                 f"fault plan: non-numeric value in {raw!r}; {GRAMMAR}"
             ) from None
+        if spec.proc is not None and spec.proc < 0:
+            raise ValueError("fault plan: proc must be >= 0")
         if "phase" in kv:
             if kv["phase"] not in _PHASES:
                 raise ValueError(
@@ -215,6 +229,16 @@ class FaultPlan:
 
     def describe(self) -> str:
         return "; ".join(s.describe() for s in self.specs)
+
+    def for_process(self, process_index: int) -> "FaultPlan":
+        """The subset of this plan addressed to `process_index`: specs
+        with a matching ``proc=`` plus the unaddressed ones. Multi-host
+        groups share one MGWFBP_FAULT_PLAN env; this is how each process
+        keeps only its own faults."""
+        return FaultPlan([
+            s for s in self.specs
+            if s.proc is None or s.proc == int(process_index)
+        ])
 
     # -- queries (all deterministic in the host counters) -----------------
     def nan_at(self, step: int) -> bool:

@@ -1,0 +1,41 @@
+"""Coordination-primitive worker for the 2-process tests (not collected
+by pytest — test_multihost.py spawns two of these as real OS processes
+coordinated by jax.distributed and compares their JSON output).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+"""
+
+import json
+import os
+import sys
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MGWFBP_HOST_DEVICES"] = "4"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mgwfbp_tpu.utils.platform import apply_platform_overrides  # noqa: E402
+
+apply_platform_overrides("cpu")
+
+from mgwfbp_tpu.parallel.mesh import init_distributed  # noqa: E402
+
+init_distributed(f"127.0.0.1:{port}", nprocs, pid)
+
+from mgwfbp_tpu.runtime import coordination as coord  # noqa: E402
+
+out = {"pid": pid, "count": coord.process_count()}
+# one host flags -> everyone agrees; nobody flags -> nobody does
+out["any"] = [coord.agree_any(pid == 1), coord.agree_any(False)]
+# unanimous -> True; one dissenter -> False
+out["all"] = [coord.agree_all(True), coord.agree_all(pid == 0)]
+# process 0's value wins regardless of the local one
+out["bcast"] = coord.broadcast_flag(41.5 if pid == 0 else -3.0)
+# per-process candidate timings: p0=[0.5, 3.0, -], p1=[1.5, 2.0, -];
+# straggler-max = [1.5, 3.0, inf] -> winner 0, everywhere
+idx, reduced = coord.all_argmin([0.5 + pid, 3.0 - pid, None])
+out["argmin"] = [idx, [t if t != float("inf") else "inf" for t in reduced]]
+coord.barrier("worker_done")
+out["barrier"] = "ok"
+print(json.dumps(out))

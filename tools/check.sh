@@ -7,23 +7,28 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/5] ruff =="
+echo "== [1/6] ruff =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mgwfbp_tpu tests tools bench.py || rc=1
 else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/5] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
+echo "== [2/6] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
 JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
 
-echo "== [3/5] telemetry report smoke (writer -> report -> exports) =="
+echo "== [3/6] telemetry report smoke (writer -> report -> exports) =="
 JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
 
-echo "== [4/5] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
+echo "== [4/6] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py || rc=1
 
-echo "== [5/5] tier-1 tests =="
+echo "== [5/6] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume) =="
+# hard timeout: a coordination bug's failure mode is a distributed HANG,
+# which must fail the gate, not wedge it
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --processes 2 || rc=1
+
+echo "== [6/6] tier-1 tests =="
 t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
 trap 'rm -f "$t1log"' EXIT                 # must not clobber each other
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

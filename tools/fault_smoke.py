@@ -1,7 +1,8 @@
 """Fault-injection smoke: the resilience lifecycle, end to end, on the CPU
 mesh (tools/check.sh stage).
 
-Drives the REAL launcher twice through subprocesses:
+Single-process (default) drives the REAL launcher twice through
+subprocesses:
 
   1. a lenet run with ``MGWFBP_FAULT_PLAN="nan@step=2;preempt@step=4"`` —
      must drop the NaN step (``bad_step`` event), drain the injected
@@ -10,12 +11,24 @@ Drives the REAL launcher twice through subprocesses:
   2. the same command with no fault plan — must resume from the exact
      mid-epoch step (``resume`` event with mid_epoch) and finish rc 0.
 
+``--processes 2`` runs the MULTI-HOST lifecycle instead (ISSUE 6): a
+2-process CPU-mesh group under the auto-resubmit supervisor with
+``preempt@step=4,proc=1`` signaling ONE process — the group must AGREE to
+drain (the un-signaled process records signal ``PEER``), checkpoint once,
+exit rc 75, get resubmitted, resume mid-epoch on both processes, and
+finish; the per-process telemetry streams must merge into one monotonic
+global timeline covering both incarnations (tools/telemetry_merge.py).
+This stage is what keeps the multi-host path from rotting back into dead
+code — the fate of the pre-ISSUE-6 multihost test, slow-marked and never
+run while CPU collectives silently stayed unconfigured.
+
 Asserts the telemetry lifecycle after each run. No accelerator, dataset,
 or network needed.
 """
 
 from __future__ import annotations
 
+import argparse
 import glob
 import json
 import os
@@ -65,7 +78,7 @@ def _events(logdir: str) -> list[dict]:
     return read_event_set(paths[0])
 
 
-def main() -> int:
+def single_process() -> dict:
     from mgwfbp_tpu.telemetry import events_of
 
     with tempfile.TemporaryDirectory(prefix="mgwfbp_fault_smoke_") as d:
@@ -92,13 +105,97 @@ def main() -> int:
         assert max(s["step"] for s in steps) == 12, (
             "resumed run did not finish both epochs"
         )
-        print(json.dumps({
+        return {
             "fault_smoke": "ok",
             "bad_steps": len(bad),
             "preempt_iteration": pre["iteration"],
             "resume_iteration": resumes[-1]["iteration"],
             "final_step": max(s["step"] for s in steps),
-        }))
+        }
+
+
+def multi_process(processes: int) -> dict:
+    from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
+    from mgwfbp_tpu.telemetry import events_of, find_stream_paths
+    from telemetry_merge import check_monotonic, merge_streams
+
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_mh_smoke_") as d:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # 4 virtual devices per process keeps the group's total world at
+        # 8 — the same scale as tier-1 — and the incarnation under ~20 s
+        env["MGWFBP_HOST_DEVICES"] = "4"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # one plan for the whole group: NaN-poison a step on every
+        # process, preempt ONLY process 1 — the drain must be agreed
+        env["MGWFBP_FAULT_PLAN"] = "nan@step=2;preempt@step=4,proc=1"
+        sup = Supervisor(
+            default_train_cmd(_cli(d)[3:]),  # strip interpreter/-m/module
+            processes,
+            backoff_base_s=0.2,
+            log_dir=os.path.join(d, "supervisor"),
+            env=env,
+        )
+        rc = sup.run()
+        assert rc == 0, f"supervised group finished rc {rc}, want 0"
+        assert len(sup.results) == 2, (
+            f"expected preempt + 1 resubmission, got "
+            f"{[r.returncodes for r in sup.results]}"
+        )
+        assert sup.results[0].preempted, sup.results[0]
+        assert sup.results[1].ok, sup.results[1]
+
+        tag_dirs = [
+            p for p in glob.glob(os.path.join(d, "*"))
+            if os.path.isdir(p) and find_stream_paths(p)
+        ]
+        assert len(tag_dirs) == 1, f"expected one run dir, got {tag_dirs}"
+        paths = find_stream_paths(tag_dirs[0])
+        assert len(paths) == processes, (
+            f"expected {processes} per-process streams, got {paths}"
+        )
+        merged = merge_streams(paths)
+        check_monotonic(merged)
+        pre = events_of(merged, "preempt")
+        signals = {r["process"]: r["signal"] for r in pre}
+        assert signals.get(1) == "SIGTERM", signals  # the signaled host
+        assert signals.get(0) == "PEER", signals     # drained by agreement
+        assert all(r["iteration"] == 4 for r in pre), pre
+        resumes = events_of(merged, "resume")
+        assert {r["process"] for r in resumes} == set(range(processes))
+        assert all(
+            r["mid_epoch"] and r["iteration"] == 4 for r in resumes
+        ), resumes
+        bad = events_of(merged, "bad_step")
+        assert {r["process"] for r in bad} == set(range(processes))
+        assert all(r["step"] == 2 for r in bad), bad
+        for p in range(processes):
+            last = max(
+                r["step"] for r in events_of(merged, "step")
+                if r["process"] == p
+            )
+            assert last == 12, f"process {p} stopped at step {last}"
+        return {
+            "fault_smoke": "ok",
+            "processes": processes,
+            "incarnations": [r.returncodes for r in sup.results],
+            "merged_records": len(merged),
+            "preempt_signals": signals,
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--processes", type=int, default=1,
+                    help="1 = single-process lifecycle (default); >1 = "
+                         "supervised multi-host group with an agreed "
+                         "drain + auto-resubmit")
+    args = ap.parse_args()
+    if args.processes > 1:
+        out = multi_process(args.processes)
+    else:
+        out = single_process()
+    print(json.dumps(out))
     return 0
 
 
