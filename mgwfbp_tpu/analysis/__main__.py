@@ -38,10 +38,14 @@ def main(argv=None) -> int:
         help="comma-separated merge policies to verify (jaxpr pass)",
     )
     parser.add_argument(
-        "--comm-ops", dest="comm_ops", default="all_reduce,rs_opt_ag",
+        "--comm-ops", dest="comm_ops",
+        default="all_reduce,rs_opt_ag,rs_fwd_ag",
         help="comma-separated bucket lowerings to verify; each policy is "
-        "traced under each (rs_opt_ag is verified with global-norm "
-        "clipping on, so the cross-group clip psum is covered too)",
+        "traced under each (rs_opt_ag/rs_fwd_ag are verified with "
+        "global-norm clipping on, so the cross-group clip psum is covered "
+        "too; rs_fwd_ag traces TWO consecutive steps — the cross-step "
+        "contract: each group's reduce-scatter in step N, its all-gather "
+        "in step N+1's forward)",
     )
     parser.add_argument("--warnings-as-errors", action="store_true",
                         help="exit non-zero on warnings too")
@@ -66,10 +70,13 @@ def main(argv=None) -> int:
             for comm_op in ops:
                 findings.extend(verify_train_step(
                     args.model, policy, comm_op=comm_op,
-                    # clipping on the sharded path also verifies the
+                    # clipping on the sharded paths also verifies the
                     # declared clip-psum scope stays the only extra
                     # collective
-                    norm_clip=1.0 if comm_op == "rs_opt_ag" else None,
+                    norm_clip=(
+                        1.0 if comm_op in ("rs_opt_ag", "rs_fwd_ag")
+                        else None
+                    ),
                 ))
         # one guard-off trace pins SCH008's other direction: disabling the
         # non-finite guard must actually remove the finite_check eqns

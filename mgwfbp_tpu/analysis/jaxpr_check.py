@@ -225,6 +225,66 @@ def _check_rs_opt_ag_group(reducer: Any, gi: int, eqns: list, add) -> None:
             f"{np.dtype(layout.dtypes[gi]).name}")
 
 
+def _check_rs_fwd_ag_group(reducer: Any, gi: int, eqns: list, add) -> None:
+    """The cross-step per-group collective contract, per STEP: exactly ONE
+    all-gather (the carried param shard, 1/world of the padded bucket, at
+    the bucket dtype — the PREVIOUS step's deferred gather landing in this
+    step's forward) followed, later in the program, by exactly ONE
+    reduce-scatter (the padded grad bucket, at the wire dtype) whose
+    updated shard carries out to the NEXT step. `eqns` preserves program
+    order (iter_eqns walks the jaxpr depth-first in sequence), so
+    AG-before-RS is exactly 'the gather sits in the forward region, the
+    scatter in the backward' — an in-step RS..AG pair (the rs_opt_ag
+    shape, i.e. the deferral silently degenerated) fails the order
+    check."""
+    layout = reducer.layout
+    optim = reducer.optim
+    comm_dtype = getattr(reducer, "comm_dtype", None)
+    reductions = [e for e in eqns if e.primitive.name in REDUCTION_PRIMS]
+    gathers = [e for e in eqns if e.primitive.name == "all_gather"]
+    extra = [e for e in eqns if e not in reductions and e not in gathers]
+    if len(reductions) != 1 or len(gathers) != 1:
+        add("SCH001",
+            f"rs_fwd_ag group {gi}: expected exactly 1 all-gather + 1 "
+            f"reduce-scatter under its scope per step, found "
+            f"{len(gathers)} gather(s) + {len(reductions)} reduction(s)")
+        return
+    for e in extra:
+        add("SCH004",
+            f"rs_fwd_ag group {gi}: unexpected '{e.primitive.name}' in "
+            "the group scope")
+    rs, ag = reductions[0], gathers[0]
+    if eqns.index(ag) > eqns.index(rs):
+        add("SCH004",
+            f"rs_fwd_ag group {gi}: the all-gather follows the "
+            "reduce-scatter in program order — the gather was NOT "
+            "deferred across the step boundary (this is the in-step "
+            "rs_opt_ag shape)")
+    padded = optim.padded_size(gi)
+    shard = optim.shard_size(gi)
+    rs_elems = _numel(rs.invars[0].aval)
+    if rs_elems != padded:
+        add("SCH007",
+            f"rs_fwd_ag group {gi}: reduce-scatter moves {rs_elems} "
+            f"elements, padded bucket is {padded}")
+    ag_elems = _numel(ag.invars[0].aval)
+    if ag_elems != shard:
+        add("SCH007",
+            f"rs_fwd_ag group {gi}: all-gather operand is {ag_elems} "
+            f"elements, the carried 1/world shard is {shard}")
+    want_wire = comm_dtype if comm_dtype is not None else layout.dtypes[gi]
+    if np.dtype(rs.invars[0].aval.dtype) != np.dtype(want_wire):
+        add("SCH002",
+            f"rs_fwd_ag group {gi}: reduce-scatter runs at dtype "
+            f"{np.dtype(rs.invars[0].aval.dtype).name}, wire dtype is "
+            f"{np.dtype(want_wire).name}")
+    if np.dtype(ag.invars[0].aval.dtype) != np.dtype(layout.dtypes[gi]):
+        add("SCH002",
+            f"rs_fwd_ag group {gi}: param all-gather runs at dtype "
+            f"{np.dtype(ag.invars[0].aval.dtype).name}, bucket dtype is "
+            f"{np.dtype(layout.dtypes[gi]).name}")
+
+
 def verify_jaxpr_against_reducer(
     closed_jaxpr: Any,
     reducer: Any,
@@ -283,6 +343,9 @@ def verify_jaxpr_against_reducer(
         if comm_op == "rs_opt_ag":
             _check_rs_opt_ag_group(reducer, gi, groups[gi], add)
             continue
+        if comm_op == "rs_fwd_ag":
+            _check_rs_fwd_ag_group(reducer, gi, groups[gi], add)
+            continue
         eqn = groups[gi][0]  # primary reduction (rs_ag/hier add gathers)
         aval = eqn.invars[0].aval
         want_elems = layout.group_sizes[gi]
@@ -308,18 +371,19 @@ def verify_jaxpr_against_reducer(
             f"unexpected '{eqn.primitive.name}' outside declared scopes "
             f"(scope: {_scope_of(eqn) or '<none>'})")
     # the sharded_clip_norm scope is not a blanket whitelist: it exists
-    # only for the rs_opt_ag lowering, and there its contract is exactly
-    # one psum of the shard squared norms — and only when the spec clips
+    # only for the sharded-update lowerings (rs_opt_ag / rs_fwd_ag), and
+    # there its contract is exactly one psum of the shard squared norms —
+    # and only when the spec clips
     clip_eqns = [
         e for e in info["allowed"]
         if "sharded_clip_norm" in _scope_segments(_scope_of(e))
     ]
-    if comm_op != "rs_opt_ag":
+    if comm_op not in ("rs_opt_ag", "rs_fwd_ag"):
         for eqn in clip_eqns:
             add("SCH004",
                 f"'{eqn.primitive.name}' under scope sharded_clip_norm "
-                f"but comm_op is {comm_op!r} (scope reserved for "
-                "rs_opt_ag)")
+                f"but comm_op is {comm_op!r} (scope reserved for the "
+                "sharded-update lowerings)")
     else:
         clips = getattr(reducer.optim.spec, "norm_clip", None) is not None
         for eqn in clip_eqns:
@@ -396,6 +460,7 @@ def trace_train_step(
     batch_size: int = 16,
     norm_clip: Optional[float] = None,
     grad_guard: bool = True,
+    steps: int = 1,
 ) -> tuple[Any, Any, list]:
     """Build and trace a representative jitted MG-WFBP train step.
 
@@ -408,7 +473,13 @@ def trace_train_step(
 
     comm_op='rs_opt_ag' traces the sharded-optimizer path (opt state as
     1/world shard buffers, params gathered post-update); norm_clip then
-    additionally exercises the cross-group clip psum.
+    additionally exercises the cross-group clip psum. comm_op='rs_fwd_ag'
+    carries params as cross-step shards (`params_struct`).
+
+    steps > 1 chains that many consecutive jitted step calls with the
+    carried state threaded through — one top-level pjit eqn per call,
+    which is what `verify_cross_step_jaxpr` splits on (steps=2 is the
+    cross-step two-step contract's program).
     """
     _ensure_cpu_devices()
     import jax
@@ -432,19 +503,23 @@ def trace_train_step(
             tx,
         )
     )
+    full_params = state.params  # canonical tree (pre any sharded carry)
     kw: dict[str, Any] = {}
     if policy == "mgwfbp":
         kw = dict(cost_model=AlphaBeta(1e-4, 1e-9))
-    if comm_op == "rs_opt_ag":
+    if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
         kw.update(optim_spec=spec, world_size=len(jax.devices()))
     reducer = make_merged_allreduce(
         state.params, axis_name=DATA_AXIS, policy=policy,
         comm_dtype=comm_dtype, comm_op=comm_op, **kw,
     )
-    if comm_op == "rs_opt_ag":
+    if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
         state = state.replace(
             opt_state=jax.eval_shape(reducer.optim.init)
         )
+    if comm_op == "rs_fwd_ag":
+        # params ride as the cross-step sharded carry
+        state = state.replace(params=reducer.optim.params_struct())
     step = make_train_step(
         model, meta, tx, mesh, reducer, donate=donate, grad_guard=grad_guard,
     )
@@ -454,10 +529,127 @@ def trace_train_step(
         ),
         "y": jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
     }
-    closed = jax.make_jaxpr(step)(state, batch)
-    leaves = jax.tree_util.tree_leaves(state.params)
+    if steps == 1:
+        closed = jax.make_jaxpr(step)(state, batch)
+    else:
+        def chained(state, *batches):
+            metrics = None
+            for b in batches:
+                state, metrics = step(state, b)
+            return state, metrics
+
+        closed = jax.make_jaxpr(chained)(state, *([batch] * steps))
+    leaves = jax.tree_util.tree_leaves(full_params)
     arr = [leaves[j] for j in reducer.perm]
     return closed, reducer, arr
+
+
+def step_subjaxprs(closed_jaxpr: Any) -> list:
+    """Top-level pjit eqns of a multi-step trace, program order — one per
+    jitted step call (the step boundary marker the cross-step verifier
+    splits on; named scopes cannot mark it, because pjit caches the first
+    call's trace and would stamp both steps with the first scope)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    return [e for e in jaxpr.eqns if e.primitive.name == "pjit"]
+
+
+def verify_cross_step_jaxpr(
+    closed_jaxpr: Any,
+    reducer: Any,
+    grad_leaves: Sequence[Any],
+    *,
+    expect_donation: bool = True,
+    expect_finite_guard: Optional[bool] = None,
+    file: str = "<cross-step trace>",
+) -> list[Finding]:
+    """The TWO-STEP contract of the rs_fwd_ag lowering (ISSUE 7).
+
+    closed_jaxpr must trace two CONSECUTIVE jitted steps with the carried
+    state threaded through (`trace_cross_step`). Each step is verified
+    against the reducer independently (SCH001/2/3/7 via the rs_fwd_ag
+    group contract, SCH004 strays, SCH005 callbacks, SCH008 finite
+    guard), which pins exactly the cross-step shape: within EVERY step,
+    each group's all-gather sits in the forward region (before its
+    reduce-scatter in program order) and consumes the carried shard the
+    PREVIOUS step's reduce-scatter + update produced — the carry is the
+    only dataflow path between the two pjit calls, so full per-step
+    coverage + in-step ordering IS 'RS in step N, AG in step N+1's
+    forward, no strays'. Donation is checked per step call (SCH006)."""
+    out: list[Finding] = []
+    steps = step_subjaxprs(closed_jaxpr)
+    if len(steps) != 2:
+        out.append(Finding(
+            file, 0, "SCH001",
+            f"cross-step trace carries {len(steps)} jitted step call(s); "
+            "the two-step contract needs exactly 2",
+        ))
+        return out
+    for si, eqn in enumerate(steps):
+        sub = eqn.params.get("jaxpr")
+        findings = verify_jaxpr_against_reducer(
+            sub, reducer, grad_leaves,
+            expect_donation=False,  # donation lives on the pjit eqn here
+            expect_finite_guard=expect_finite_guard,
+            file=f"{file}#step{si}",
+        )
+        out.extend(findings)
+        if expect_donation:
+            donated = eqn.params.get("donated_invars")
+            if donated is None or not any(donated):
+                out.append(Finding(
+                    f"{file}#step{si}", 0, "SCH006",
+                    "no donated input buffers on the jitted step "
+                    "(params/opt-state copy every iteration)",
+                ))
+    return out
+
+
+def trace_cross_step(
+    model_name: str = "lenet",
+    policy: str = "mgwfbp",
+    *,
+    comm_dtype: Any = None,
+    donate: bool = True,
+    batch_size: int = 16,
+    norm_clip: Optional[float] = None,
+    grad_guard: bool = True,
+) -> tuple[Any, Any, list]:
+    """Trace TWO consecutive jitted rs_fwd_ag train steps with the carried
+    state threaded through — the two-step program `verify_cross_step_jaxpr`
+    checks. Thin alias of `trace_train_step(..., comm_op='rs_fwd_ag',
+    steps=2)` so the trace protocol has exactly one owner."""
+    return trace_train_step(
+        model_name, policy, comm_op="rs_fwd_ag", comm_dtype=comm_dtype,
+        donate=donate, batch_size=batch_size, norm_clip=norm_clip,
+        grad_guard=grad_guard, steps=2,
+    )
+
+
+def verify_cross_step_train_step(
+    model_name: str = "lenet",
+    policy: str = "mgwfbp",
+    *,
+    comm_dtype: Any = None,
+    donate: bool = True,
+    expect_donation: Optional[bool] = None,
+    batch_size: int = 16,
+    norm_clip: Optional[float] = None,
+    grad_guard: bool = True,
+    expect_finite_guard: Optional[bool] = None,
+) -> list[Finding]:
+    """Trace + verify the representative two-step rs_fwd_ag program."""
+    closed, reducer, arr = trace_cross_step(
+        model_name, policy, comm_dtype=comm_dtype, donate=donate,
+        batch_size=batch_size, norm_clip=norm_clip, grad_guard=grad_guard,
+    )
+    return verify_cross_step_jaxpr(
+        closed, reducer, arr,
+        expect_donation=donate if expect_donation is None else expect_donation,
+        expect_finite_guard=(
+            grad_guard if expect_finite_guard is None else expect_finite_guard
+        ),
+        file=f"<cross-step {model_name}/{policy}/rs_fwd_ag>",
+    )
 
 
 def verify_train_step(
@@ -475,7 +667,16 @@ def verify_train_step(
 ) -> list[Finding]:
     """Trace one representative jitted train step and verify it (the
     finite guard is expected exactly as built unless overridden — the
-    override exists for the analyzer's own mutation tests)."""
+    override exists for the analyzer's own mutation tests). The cross-step
+    rs_fwd_ag lowering dispatches to the TWO-step trace: its contract
+    spans a step boundary (RS in step N, AG in step N+1's forward)."""
+    if comm_op == "rs_fwd_ag":
+        return verify_cross_step_train_step(
+            model_name, policy, comm_dtype=comm_dtype, donate=donate,
+            expect_donation=expect_donation, batch_size=batch_size,
+            norm_clip=norm_clip, grad_guard=grad_guard,
+            expect_finite_guard=expect_finite_guard,
+        )
     closed, reducer, arr = trace_train_step(
         model_name, policy, comm_op=comm_op, comm_dtype=comm_dtype,
         donate=donate, batch_size=batch_size, norm_clip=norm_clip,

@@ -56,11 +56,30 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "measured_fields from prior_fields per entry.")
     p.add_argument("--prior-world-sizes", default="2,4,8,16",
                    help="extents for the prior-extended entries")
+    p.add_argument("--forward", action="store_true",
+                   help="LAYER-profile mode (needs --model): benchmark the "
+                        "model's per-layer backward AND forward durations "
+                        "on one device and write a layer profile "
+                        "(tb_profile.json format, schema_version=2 with "
+                        "tf_s) to --out — the forward timeline the "
+                        "cross-step rs_fwd_ag solver prices deferred "
+                        "all-gathers against. Unstamped legacy profiles "
+                        "without tf_s still load (forward times default "
+                        "to 0 with a warning; see "
+                        "profiling.load_layer_profile).")
+    p.add_argument("--model", default=None,
+                   help="model to benchmark in --forward mode (e.g. lenet)")
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="per-device batch for the --forward benchmark")
     args = p.parse_args(argv)
     if args.prior_extend and args.world_sizes:
         p.error("--prior-extend and --world-sizes are mutually exclusive: "
                 "the former measures ONE world size and prior-fills the "
                 "rest, the latter measures each listed extent")
+    if args.forward and not args.model:
+        p.error("--forward needs --model (the layer profile is per-model)")
+    if args.forward:
+        return _forward_main(args)
 
     from mgwfbp_tpu.utils.platform import apply_platform_overrides
 
@@ -220,6 +239,104 @@ def main(argv: Optional[list[str]] = None) -> int:
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     save_profile(args.out, out_model, meta=meta)
     print(json.dumps(report))
+    return 0
+
+
+def _forward_main(args) -> int:
+    """--forward: per-layer backward + forward benchmark -> layer profile
+    (the tb_profile.json format trainers persist, schema_version=2)."""
+    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.parallel.allreduce import arrival_order
+    from mgwfbp_tpu.profiling import (
+        LAYER_PROFILE_SCHEMA_VERSION,
+        benchmark_trainer_backward,
+        benchmark_trainer_forward,
+    )
+    from mgwfbp_tpu.train.step import create_train_state
+
+    model, meta = zoo.create_model(args.model)
+    rng = jax.random.PRNGKey(0)
+    import optax
+
+    state = create_train_state(
+        rng, model,
+        jnp.zeros((1,) + tuple(meta.input_shape), meta.input_dtype),
+        optax.sgd(0.1),
+    )
+    b = max(args.batch_size, 1)
+    rs = np.random.RandomState(0)
+    if meta.task == "lm":
+        t = int(meta.input_shape[0])
+        batch = {
+            "x": jnp.asarray(
+                rs.randint(0, meta.num_classes, (b, t)), jnp.int32
+            ),
+            "y": jnp.asarray(
+                rs.randint(0, meta.num_classes, (b, t)), jnp.int32
+            ),
+        }
+    elif meta.task == "ctc":
+        # speech batch shape: (b, time, feat) float inputs with per-sample
+        # lengths, label ids with label lengths (make_loss_fn's ctc branch
+        # reads all four keys)
+        t = int(meta.input_shape[0])
+        label_t = max(t // 8, 4)
+        batch = {
+            "x": jnp.asarray(rs.randn(b, *meta.input_shape), jnp.float32),
+            "input_lengths": jnp.full((b,), t, jnp.int32),
+            "y": jnp.asarray(
+                rs.randint(1, meta.num_classes, (b, label_t)), jnp.int32
+            ),
+            "label_lengths": jnp.full((b,), label_t, jnp.int32),
+        }
+    else:
+        batch = {
+            "x": jnp.asarray(
+                rs.randn(b, *meta.input_shape), jnp.float32
+            ),
+            "y": jnp.asarray(rs.randint(0, meta.num_classes, (b,)), jnp.int32),
+        }
+    paths = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    names = [jax.tree_util.keystr(kp) for kp, _ in paths]
+    perm = arrival_order(len(names), names=names)
+    tb = benchmark_trainer_backward(
+        model, meta, state.params, state.batch_stats, batch, perm,
+        warmup=args.warmup, iters=args.iters, names=names,
+    )
+    tf = benchmark_trainer_forward(
+        model, meta, state.params, state.batch_stats, batch, perm,
+        warmup=args.warmup, iters=args.iters, names=names,
+    )
+    doc = {
+        "schema_version": LAYER_PROFILE_SCHEMA_VERSION,
+        "tb_s": list(tb),
+        "tf_s": list(tf),
+        "arrival_names": [names[j] for j in perm],
+        "total_s": sum(tb),
+        "tf_total_s": sum(tf),
+        "source": getattr(tb, "source", "volume-prior"),
+        "tf_source": getattr(tf, "source", "volume-prior"),
+        "meta": {"model": args.model, "batch_size": b},
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(json.dumps({
+        "model": args.model,
+        "tb_total_s": doc["total_s"],
+        "tf_total_s": doc["tf_total_s"],
+        "layers": len(doc["tb_s"]),
+        "out": args.out,
+    }))
     return 0
 
 

@@ -63,7 +63,12 @@ class TrainConfig:
     # bucket) | hier (two-level ICI+DCN lowering; needs dcn_slices > 1) |
     # rs_opt_ag (ZeRO-1-style: optimizer update runs on the 1/world bucket
     # shard between reduce-scatter and a param all-gather; opt state stays
-    # device-sharded between steps — needs a bucketing policy, no compressor)
+    # device-sharded between steps — needs a bucketing policy, no
+    # compressor) | rs_fwd_ag (cross-step pipelining: rs_opt_ag whose
+    # per-group all-gather is DEFERRED into the NEXT step's forward, so
+    # comm hides behind forward compute too; params carried as 1/world
+    # shards between steps — same constraints as rs_opt_ag, single-process
+    # only for now)
 
     # numerics
     dtype: str = "float32"  # param/compute dtype

@@ -207,6 +207,33 @@ jax.tree_util.register_pytree_node(
 )
 
 
+class ShardedParams:
+    """Parameters in cross-step carry form (comm_op='rs_fwd_ag'): one flat
+    (world, shard_len) buffer per merge group, sharded over the data axes
+    between steps exactly like `ShardedOptState` buffers.
+
+    This is the state the DeAR-style lowering (arXiv:2302.12445) carries
+    across the step boundary: step N's reduce-scatter + shard optimizer
+    update produce these buffers, and step N+1's FORWARD all-gathers each
+    group just-in-time before its first consuming layer. The canonical
+    replicated pytree exists only transiently (inside the step after the
+    gathers, and host-side at checkpoint/eval boundaries via
+    `ShardedOptimStep.gather_params`/`scatter_params`)."""
+
+    def __init__(self, groups):
+        self.groups = tuple(groups)
+
+    def __repr__(self):
+        return f"ShardedParams(groups={len(self.groups)})"
+
+
+jax.tree_util.register_pytree_node(
+    ShardedParams,
+    lambda s: ((s.groups,), None),
+    lambda _, ch: ShardedParams(groups=ch[0]),
+)
+
+
 @dataclasses.dataclass(frozen=True, eq=False)
 class ShardedOptimStep:
     """(layout, optimizer-update-on-flat-buffers) for the rs_opt_ag seam.
@@ -349,6 +376,58 @@ class ShardedOptimStep:
         count = jnp.asarray(np.asarray(state.count))
         return _map_count_leaves(
             out, lambda leaf: jnp.asarray(count, leaf.dtype)
+        )
+
+    # -- cross-step param carry (comm_op='rs_fwd_ag') --------------------
+    # Params use the SAME padded-shard layout as the opt-state slots, so
+    # one layout/world pair describes grads, params, and optimizer state;
+    # the traced step's all-gather and the host-side interchange below can
+    # never disagree on where a leaf's elements live.
+
+    def params_partition_spec(self) -> ShardedParams:
+        """PartitionSpecs matching `scatter_params` output: every group
+        buffer split over the data axes (the shard each device owns)."""
+        from jax.sharding import PartitionSpec as P
+
+        return ShardedParams(
+            tuple(P(self.axes) for _ in range(self.layout.num_groups))
+        )
+
+    def params_struct(self) -> ShardedParams:
+        """Abstract ShardedParams (ShapeDtypeStructs) matching
+        `scatter_params` output — for tracing-only consumers (the jaxpr
+        verifier), where no concrete params exist to scatter."""
+        return ShardedParams(
+            tuple(
+                jax.ShapeDtypeStruct(
+                    (self.world, self.shard_size(gi)),
+                    self.layout.dtypes[gi],
+                )
+                for gi in range(self.layout.num_groups)
+            )
+        )
+
+    def scatter_params(self, params: Any) -> ShardedParams:
+        """Replicated param pytree -> the cross-step sharded carry form
+        (host-side numpy pack; checkpoint-restore / init path)."""
+        return ShardedParams(
+            tuple(
+                jnp.asarray(b)
+                for b in self._pack_slot(jax.tree_util.tree_leaves(params))
+            )
+        )
+
+    def gather_params(self, shards: ShardedParams, params_template: Any):
+        """Sharded carry -> the canonical replicated param pytree
+        (host-side numpy unpack; checkpoint-save / eval path).
+        `params_template` supplies structure and leaf dtypes (arrays or
+        ShapeDtypeStructs)."""
+        leaves = self._unpack_slot(shards.groups)
+        treedef = jax.tree_util.tree_structure(params_template)
+        refs = jax.tree_util.tree_leaves(params_template)
+        return jax.tree_util.tree_unflatten(
+            treedef,
+            [jnp.asarray(a, r.dtype) for a, r in zip(leaves, refs)],
         )
 
     def scatter(self, opt_state: Any, params: Any) -> ShardedOptState:
@@ -498,6 +577,169 @@ def _chain_token(buf: jax.Array, token) -> jax.Array:
     return buf + jnp.zeros((), buf.dtype) * clean.astype(buf.dtype)
 
 
+def _rs_phase(
+    g_arr, layout, optim, axes, world, mean, comm_dtype, sequential, token
+):
+    """Reduce-scatter every group's grad bucket (shared by the rs_opt_ag
+    and rs_fwd_ag lowerings). Returns (per-group reduced mean shards,
+    last token)."""
+    g_shards: list[jax.Array] = []
+    for gi in range(layout.num_groups):
+        with jax.named_scope(group_scope_name(gi)):
+            buf = buckets_lib.pack_group(g_arr, layout, gi)
+            orig_dtype = buf.dtype
+            if comm_dtype is not None and buf.dtype != comm_dtype:
+                buf = buf.astype(comm_dtype)
+            if sequential:
+                buf = _chain_token(buf, token)
+            pad = optim.padded_size(gi) - buf.shape[0]
+            if pad:
+                buf = jnp.pad(buf, (0, pad))
+            shard = lax.psum_scatter(
+                buf, axes, scatter_dimension=0, tiled=True
+            )
+            token = shard[0]
+            if shard.dtype != orig_dtype:
+                shard = shard.astype(orig_dtype)
+            if mean:
+                shard = shard / world
+            g_shards.append(shard)
+    return g_shards, token
+
+
+def _clip_phase(g_shards, optim, axes):
+    """Global-norm clip scale: one cross-group psum of shard squared norms
+    (scope CLIP_NORM_SCOPE) — the only way a global norm exists while every
+    bucket is scattered. None when the spec does not clip."""
+    if optim.spec.norm_clip is None:
+        return None
+    with jax.named_scope(CLIP_NORM_SCOPE):
+        local = sum(
+            jnp.sum(s.astype(jnp.float32) ** 2) for s in g_shards
+        )
+        g_norm = jnp.sqrt(lax.psum(local, axes))
+        # (g_norm, threshold) pair; the shard update applies optax's
+        # exact clip arithmetic (see update_shard)
+        return (g_norm, jnp.float32(optim.spec.norm_clip))
+
+
+def merged_fwd_allgather(
+    param_shards: ShardedParams,
+    layout: BucketLayout,
+    perm: Sequence[int],
+    axis_name: str | tuple[str, ...],
+    optim: ShardedOptimStep,
+    treedef: Any,
+    sequential: bool = True,
+) -> Any:
+    """The cross-step lowering's FORWARD half: all-gather each merge
+    group's carried param shard (produced by the PREVIOUS step's
+    reduce-scatter + shard update) back into full leaves, group by group
+    under the same `mgwfbp_groupNNNN` scopes.
+
+    Groups are issued in REVERSE arrival order — the forward-consumption
+    order: group G-1 holds the first forward layers (gradient-arrival
+    index 0 is the LAST forward layer), so its gather must land first,
+    while group 0's gather has the whole forward pass to hide behind. The
+    sequential token chain serializes the gathers in that order (the
+    solver's one-collective-at-a-time link model) and keeps XLA's
+    AllGatherCombiner from re-merging them; dataflow alone guarantees each
+    layer's forward waits for exactly its own group's gather — the
+    AG-before-first-use deadline the cross-step cost model prices.
+    """
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    out: list[Any] = [None] * len(optim.shapes)
+    token = None
+    for gi in reversed(range(layout.num_groups)):
+        with jax.named_scope(group_scope_name(gi)):
+            shard = param_shards.groups[gi].reshape(-1)
+            if sequential:
+                shard = _chain_token(shard, token)
+            full = lax.all_gather(shard, axes, axis=0, tiled=True)
+            token = full[0]
+            n = layout.group_sizes[gi]
+            if full.shape[0] != n:
+                full = full[:n]
+            unpacked = buckets_lib.unpack_group(
+                full, layout, gi, optim.shapes
+            )
+        for i, a in unpacked.items():
+            out[i] = a
+    restored: list[Any] = [None] * len(out)
+    for k, j in enumerate(perm):
+        restored[j] = out[k]
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def merged_rs_defer(
+    grads: Any,
+    param_shards: ShardedParams,
+    opt_state: ShardedOptState,
+    layout: BucketLayout,
+    perm: Sequence[int],
+    axis_name: str | tuple[str, ...],
+    optim: ShardedOptimStep,
+    mean: bool = True,
+    comm_dtype: Optional[Any] = None,
+    sequential: bool = True,
+) -> tuple[ShardedParams, ShardedOptState]:
+    """The cross-step lowering's BACKWARD half: reduce-scatter each merge
+    group's grad bucket, update the carried param/opt-state shard — and
+    STOP. No all-gather is issued: the updated shards ride out of the step
+    as carried state, and the NEXT step's forward gathers them
+    (`merged_fwd_allgather`). This is what moves each group's gather off
+    the backward-side critical path and onto the next step's forward
+    timeline (DeAR, arXiv:2302.12445).
+
+    Numerically identical to `merged_rs_opt_ag` per step — same
+    reduce-scatter, same fused shard update, same clip psum — only the
+    gather's position in the program moves; params gathered at step N+1
+    equal the values an rs_opt_ag step N would have gathered in-step.
+    """
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    world = axis_size(axes)
+    if world != optim.world:
+        raise ValueError(
+            f"rs_fwd_ag: mesh extent {world} over {axes} != the "
+            f"ShardedOptimStep's world {optim.world}; rebuild the reducer "
+            "for this mesh"
+        )
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    g_arr = [g_leaves[j] for j in perm]
+    rank = _device_rank(axes)
+
+    g_shards, token = _rs_phase(
+        g_arr, layout, optim, axes, world, mean, comm_dtype, sequential,
+        token=None,
+    )
+    clip_scale = _clip_phase(g_shards, optim, axes)
+
+    new_groups: list[jax.Array] = []
+    new_slots: list[list[jax.Array]] = [
+        [None] * layout.num_groups for _ in range(optim.num_slots)
+    ]
+    count = opt_state.count
+    for gi in range(layout.num_groups):
+        with jax.named_scope(group_scope_name(gi)):
+            p_shard = param_shards.groups[gi].reshape(-1)
+            slots_in = tuple(
+                opt_state.slots[s][gi].reshape(-1)
+                for s in range(optim.num_slots)
+            )
+            new_p, slots_out = optim.update_shard(
+                gi, g_shards[gi], p_shard, slots_in, count, clip_scale, rank
+            )
+            new_groups.append(new_p[None, :])
+            for s in range(optim.num_slots):
+                new_slots[s][gi] = slots_out[s][None, :]
+    return (
+        ShardedParams(tuple(new_groups)),
+        ShardedOptState(
+            count=count + 1, slots=tuple(tuple(s) for s in new_slots)
+        ),
+    )
+
+
 def merged_rs_opt_ag(
     grads: Any,
     params: Any,
@@ -549,40 +791,13 @@ def merged_rs_opt_ag(
     num_groups = layout.num_groups
 
     # ---- phase 1: reduce-scatter every group's grad bucket ----
-    g_shards: list[jax.Array] = []
-    token = None
-    for gi in range(num_groups):
-        with jax.named_scope(group_scope_name(gi)):
-            buf = buckets_lib.pack_group(g_arr, layout, gi)
-            orig_dtype = buf.dtype
-            if comm_dtype is not None and buf.dtype != comm_dtype:
-                buf = buf.astype(comm_dtype)
-            if sequential:
-                buf = _chain_token(buf, token)
-            pad = optim.padded_size(gi) - buf.shape[0]
-            if pad:
-                buf = jnp.pad(buf, (0, pad))
-            shard = lax.psum_scatter(
-                buf, axes, scatter_dimension=0, tiled=True
-            )
-            token = shard[0]
-            if shard.dtype != orig_dtype:
-                shard = shard.astype(orig_dtype)
-            if mean:
-                shard = shard / world
-            g_shards.append(shard)
+    g_shards, token = _rs_phase(
+        g_arr, layout, optim, axes, world, mean, comm_dtype, sequential,
+        token=None,
+    )
 
     # ---- phase 2: global-norm clip scale (cross-group psum) ----
-    clip_scale = None
-    if optim.spec.norm_clip is not None:
-        with jax.named_scope(CLIP_NORM_SCOPE):
-            local = sum(
-                jnp.sum(s.astype(jnp.float32) ** 2) for s in g_shards
-            )
-            g_norm = jnp.sqrt(lax.psum(local, axes))
-            # (g_norm, threshold) pair; the shard update applies optax's
-            # exact clip arithmetic (see update_shard)
-            clip_scale = (g_norm, jnp.float32(optim.spec.norm_clip))
+    clip_scale = _clip_phase(g_shards, optim, axes)
 
     # ---- phase 3: shard update + param all-gather ----
     out: list[Any] = [None] * len(g_arr)
@@ -676,7 +891,9 @@ def merged_psum(
         raise ValueError(
             f"unknown comm_op {comm_op!r}; expected 'all_reduce', 'rs_ag' "
             "or 'hier' (the 'rs_opt_ag' lowering consumes params/opt-state "
-            "too — call MergedAllreduce.reduce_and_update)"
+            "too — call MergedAllreduce.reduce_and_update; 'rs_fwd_ag' "
+            "splits across the step boundary — gather_params / "
+            "reduce_and_defer)"
         )
     if compressor is not None and comm_op != "all_reduce":
         raise ValueError(
@@ -750,15 +967,21 @@ class MergedAllreduce:
     comm_op: str = "all_reduce"  # all_reduce | rs_ag (DeAR decomposition) |
     # hier (two-level ICI+DCN; needs axis_name=(inner_ici, outer_dcn) —
     # the trainer wires it via --dcn-slices + --comm-op hier) |
-    # rs_opt_ag (sharded optimizer between RS and AG; needs `optim`)
-    optim: Optional[ShardedOptimStep] = None  # rs_opt_ag only
+    # rs_opt_ag (sharded optimizer between RS and AG; needs `optim`) |
+    # rs_fwd_ag (cross-step: RS + shard update at backward, the param
+    # all-gather deferred into the NEXT step's forward; needs `optim`,
+    # params carried as ShardedParams)
+    optim: Optional[ShardedOptimStep] = None  # rs_opt_ag / rs_fwd_ag only
+    # pytree structure of the param/grad tree (rs_fwd_ag's in-step gather
+    # rebuilds the full params from shards without a tree-shaped argument)
+    treedef: Optional[Any] = None
 
     def __call__(self, grads: Any) -> Any:
-        if self.comm_op == "rs_opt_ag":
+        if self.comm_op in ("rs_opt_ag", "rs_fwd_ag"):
             raise ValueError(
-                "comm_op='rs_opt_ag' folds the optimizer into the "
-                "collective; call reduce_and_update(grads, params, "
-                "opt_state) instead of the grads-only reduction"
+                f"comm_op={self.comm_op!r} folds the optimizer into the "
+                "collective; call reduce_and_update / reduce_and_defer "
+                "instead of the grads-only reduction"
             )
         return merged_psum(
             grads,
@@ -795,6 +1018,53 @@ class MergedAllreduce:
             sequential=self.sequential,
         )
 
+    # -- the cross-step (rs_fwd_ag) halves --------------------------------
+    def gather_params(self, param_shards: ShardedParams) -> Any:
+        """The FORWARD half of the cross-step step: gather the carried
+        shards into the full param pytree, group by group in
+        forward-consumption order (traced; see merged_fwd_allgather)."""
+        if self.comm_op != "rs_fwd_ag" or self.optim is None:
+            raise ValueError(
+                "gather_params requires comm_op='rs_fwd_ag' (built via "
+                "make_merged_allreduce(..., optim_spec=..., world_size=...))"
+            )
+        return merged_fwd_allgather(
+            param_shards,
+            self.layout,
+            self.perm,
+            self.axis_name,
+            self.optim,
+            self.treedef,
+            sequential=self.sequential,
+        )
+
+    def reduce_and_defer(
+        self,
+        grads: Any,
+        param_shards: ShardedParams,
+        opt_state: ShardedOptState,
+    ) -> tuple[ShardedParams, ShardedOptState]:
+        """The BACKWARD half of the cross-step step: reduce-scatter grads,
+        update the carried shards, defer the gather to the next step's
+        forward (traced; see merged_rs_defer)."""
+        if self.comm_op != "rs_fwd_ag" or self.optim is None:
+            raise ValueError(
+                "reduce_and_defer requires comm_op='rs_fwd_ag' (built via "
+                "make_merged_allreduce(..., optim_spec=..., world_size=...))"
+            )
+        return merged_rs_defer(
+            grads,
+            param_shards,
+            opt_state,
+            self.layout,
+            self.perm,
+            self.axis_name,
+            self.optim,
+            mean=self.mean,
+            comm_dtype=self.comm_dtype,
+            sequential=self.sequential,
+        )
+
 
 def make_merged_allreduce(
     params_or_shapes: Any,
@@ -802,6 +1072,7 @@ def make_merged_allreduce(
     axis_name: str | tuple[str, ...],
     policy: str = "mgwfbp",
     tb: Optional[Sequence[float]] = None,
+    tf: Optional[Sequence[float]] = None,
     cost_model: Any = None,
     threshold: int = 0,
     perm: Optional[Sequence[int]] = None,
@@ -823,10 +1094,13 @@ def make_merged_allreduce(
     the dominant term of backward time for conv/dense layers, so the schedule
     degrades gracefully before profiling has run.
 
-    comm_op='rs_opt_ag' additionally needs `optim_spec` (the elementwise
-    optimizer to run on the bucket shards, optim.OptimSpec) and
-    `world_size` (the static extent of the data axes — shard layouts must
-    exist before any mesh axis is bound).
+    comm_op='rs_opt_ag' (and the cross-step 'rs_fwd_ag') additionally
+    needs `optim_spec` (the elementwise optimizer to run on the bucket
+    shards, optim.OptimSpec) and `world_size` (the static extent of the
+    data axes — shard layouts must exist before any mesh axis is bound).
+    For 'rs_fwd_ag', `tf` is the arrival-ordered per-layer FORWARD profile
+    the cross-step simulate prices AG-before-first-use deadlines against
+    (falls back to `solver.forward_prior_tf(tb)` when absent).
 
     groups: an EXPLICIT arrival-order grouping that bypasses the policy
     solve (autotuner candidates / schedule-cache hits; see
@@ -841,14 +1115,14 @@ def make_merged_allreduce(
         all_names = list(names)
     # fail at construction, not at first traced call
     _check_hier_axes(comm_op, axis_name)
-    if comm_op == "rs_opt_ag":
+    if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
         if optim_spec is None or world_size is None:
             raise ValueError(
-                "comm_op='rs_opt_ag' requires optim_spec and world_size"
+                f"comm_op={comm_op!r} requires optim_spec and world_size"
             )
         if compressor is not None:
             raise ValueError(
-                "comm_op='rs_opt_ag' cannot combine with a sparsifying "
+                f"comm_op={comm_op!r} cannot combine with a sparsifying "
                 "compressor (the shard update needs the dense reduction)"
             )
     p = arrival_order(n, perm, names=all_names)
@@ -871,8 +1145,12 @@ def make_merged_allreduce(
         # model). A measured tb (Trainer._profile_backward) always takes
         # precedence.
         tb = size_prior_tb(specs, cost_model)
+    if comm_op == "rs_fwd_ag" and tb is not None and tf is None:
+        from mgwfbp_tpu.parallel.solver import forward_prior_tf
+
+        tf = forward_prior_tf(tb)
     schedule = build_schedule(
-        specs, tb, policy=policy, cost_model=cost_model,
+        specs, tb, tf=tf, policy=policy, cost_model=cost_model,
         threshold=threshold, comm_op=comm_op,
         groups=groups, policy_detail=policy_detail,
     )
@@ -885,12 +1163,26 @@ def make_merged_allreduce(
         if tb is not None and cost_model is not None:
             cost_fn = effective_cost_fn(cost_model, comm_op)
             sizes_b = [s.nbytes for s in specs]
-            total, nonoverlap, comm = simulate_groups(
-                layout.groups, sizes_b, tb, cost_fn,
-                float(getattr(cost_model, "gamma", 0.0)),
-                float(getattr(cost_model, "overlap", 1.0)),
-                float(getattr(cost_model, "pack_beta", 0.0)),
-            )
+            if comm_op == "rs_fwd_ag":
+                from mgwfbp_tpu.parallel.solver import (
+                    cross_step_phase_costs,
+                    simulate_cross_step,
+                )
+
+                rs_cost, ag_cost = cross_step_phase_costs(cost_model)
+                total, nonoverlap, comm = simulate_cross_step(
+                    layout.groups, sizes_b, tb, tf, rs_cost, ag_cost,
+                    float(getattr(cost_model, "gamma", 0.0)),
+                    float(getattr(cost_model, "overlap", 1.0)),
+                    float(getattr(cost_model, "pack_beta", 0.0)),
+                )
+            else:
+                total, nonoverlap, comm = simulate_groups(
+                    layout.groups, sizes_b, tb, cost_fn,
+                    float(getattr(cost_model, "gamma", 0.0)),
+                    float(getattr(cost_model, "overlap", 1.0)),
+                    float(getattr(cost_model, "pack_beta", 0.0)),
+                )
             schedule = dataclasses.replace(
                 schedule,
                 predicted_total_time=total,
@@ -901,7 +1193,7 @@ def make_merged_allreduce(
                 ),
             )
     optim = None
-    if comm_op == "rs_opt_ag":
+    if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
         axes = (
             (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
         )
@@ -923,4 +1215,5 @@ def make_merged_allreduce(
         compressor=compressor,
         comm_op=comm_op,
         optim=optim,
+        treedef=jax.tree_util.tree_structure(params_or_shapes),
     )

@@ -96,14 +96,25 @@ def allowed_comm_ops(base: str) -> tuple[str, ...]:
     """Lowerings a candidate may race under, given the configured one.
 
     all_reduce and rs_ag are freely interchangeable (same replicated state,
-    numerically identical reduction), so candidates race under both. hier is
-    pinned to its two-axis mesh and rs_opt_ag owns the device-sharded
+    numerically identical reduction), so candidates race under both. hier
+    is pinned to its two-axis mesh and rs_opt_ag owns the device-sharded
     optimizer state (a different state layout per schedule is already
     handled by the hot-swap seam, but a different *optimizer contract*
     mid-run is not a tuning knob) — those race schedule shapes only.
+
+    A run CONFIGURED for the cross-step rs_fwd_ag lowering races against
+    the in-step interchangeable pair too: the user already opted into the
+    sharded-optimizer contract, the hot-swap seam moves freely between the
+    carries (gather to the replicated interchange form, re-scatter), and
+    the whole point of the cross-step race is measuring whether deferring
+    the gathers actually beats hiding everything behind backward on this
+    link. The reverse direction stays off (an all_reduce run never swaps
+    INTO the sharded contract uninvited).
     """
     if base in ("all_reduce", "rs_ag"):
         return ("all_reduce", "rs_ag")
+    if base == "rs_fwd_ag":
+        return ("rs_fwd_ag", "all_reduce", "rs_ag")
     return (base,)
 
 
@@ -113,6 +124,7 @@ def build_candidates(
     cost_model,
     comm_ops: Sequence[str],
     *,
+    tf: Optional[Sequence[float]] = None,
     max_candidates: int = 6,
     incumbent: Optional[tuple[Sequence[Sequence[int]], str]] = None,
 ) -> list[Candidate]:
@@ -121,6 +133,14 @@ def build_candidates(
     Candidates are ranked by predicted total step time and capped at
     `max_candidates`; the incumbent (the live solved schedule) is always
     included — the race must be able to conclude "keep what we have".
+
+    tf: arrival-ordered per-layer forward profile for pricing cross-step
+    (rs_fwd_ag) candidates — their `simulate_cross_step` totals are
+    backward-anchored, so the ranking here compares them directly with the
+    in-step lowerings' `simulate_groups` totals (both exclude the sum(tf)
+    compute floor every lowering pays identically). Defaults to
+    `solver.forward_prior_tf(tb)` when a cross-step op is racing without
+    a measured forward profile.
     """
     gamma = float(getattr(cost_model, "gamma", 0.0))
     overlap = float(getattr(cost_model, "overlap", 1.0))
@@ -131,10 +151,25 @@ def build_candidates(
     seen: set[tuple] = set()
     for op in comm_ops:
         cost = effective_cost_fn(cost_model, op)
+        cross = None
+        if op == "rs_fwd_ag":
+            from mgwfbp_tpu.parallel.solver import (
+                cross_step_phase_costs,
+                forward_prior_tf,
+            )
+
+            rs_cost, ag_cost = cross_step_phase_costs(cost_model)
+            cross = (
+                list(tf) if tf is not None else forward_prior_tf(tb),
+                rs_cost,
+                ag_cost,
+            )
+            cost = rs_cost  # the scan's link cost at backward time
         for detail, groups, pred in schedule_frontier(
             sizes, tb, cost_model.alpha, cost, itemsizes, gamma=gamma,
             overlap=overlap, pack_beta=pack_beta,
             max_candidates=max(max_candidates, 2),
+            cross_step=cross,
         ):
             key = (op, tuple(map(tuple, groups)))
             if key in seen:

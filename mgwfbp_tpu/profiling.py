@@ -459,6 +459,7 @@ def trace_layerwise_backward(
     iters: int = 5,
     logdir: Optional[str] = None,
     total_s: Optional[float] = None,
+    prefer: str = "backward",
 ) -> Optional[list[float]]:
     """Measure per-leaf backward durations from a profiler trace.
 
@@ -498,9 +499,14 @@ def trace_layerwise_backward(
         return None
     scopes = _leaf_scopes(names)
     scope_set = sorted(set(scopes), key=len, reverse=True)  # longest first
-    # prefer explicit backward events; fall back to any scope-tagged event
-    bwd = [r for r in rows if "transpose" in r[0]]
-    pool = bwd if bwd else rows
+    # prefer events from the requested pass (XLA stamps backward ops with
+    # `transpose(jvp(...))` in the name stack; forward ops carry the bare
+    # module scope); fall back to any scope-tagged event
+    if prefer == "forward":
+        picked = [r for r in rows if "transpose" not in r[0]]
+    else:
+        picked = [r for r in rows if "transpose" in r[0]]
+    pool = picked if picked else rows
     scope_time: dict[str, float] = {}
     for ident, dur in pool:
         for sc in scope_set:
@@ -597,6 +603,111 @@ def benchmark_trainer_backward(
     return benchmark_backward(
         scalar_loss, params, (example_batch,), perm, warmup=warmup, iters=iters
     )
+
+
+def benchmark_trainer_forward(
+    model: Any,
+    meta: Any,
+    params: Any,
+    batch_stats: Any,
+    example_batch: dict,
+    perm: Sequence[int],
+    warmup: int = 5,
+    iters: int = 50,
+    names: Optional[Sequence[str]] = None,
+    compute_dtype: Optional[Any] = None,
+) -> "TbProfile":
+    """`benchmark_trainer_backward`'s twin for the FORWARD pass: measure
+    the model's loss forward on one device and return arrival-ordered
+    per-layer durations tf.
+
+    This is the forward timeline the cross-step (rs_fwd_ag) solver prices
+    deferred all-gathers against: group g's gather must land before the
+    forward reaches its first consuming layer, so the solver needs to know
+    how much forward compute precedes each layer. Attribution mirrors the
+    backward benchmark: profiler-trace events keyed by module name-stack
+    scopes where the backend preserves them (prefer='forward' keeps the
+    non-`transpose` events), the measured total split by the volume prior
+    otherwise; the measured TOTAL always comes from the AOT-compiled
+    executable under the bench protocol, like tb.
+    """
+    from mgwfbp_tpu.train.step import make_loss_fn
+
+    loss_fn = make_loss_fn(model, meta, compute_dtype=compute_dtype)
+    rng = jax.random.PRNGKey(0)
+    carry = None
+    if getattr(meta, "has_carry", False):
+        carry = model.initial_carry(example_batch["x"].shape[0])
+
+    def scalar_loss(p, batch):
+        loss, _ = loss_fn(p, batch_stats, batch, rng, carry)
+        return loss
+
+    fwd_fn = jax.jit(lambda p: scalar_loss(p, example_batch))
+    run = fwd_fn
+    try:
+        run = fwd_fn.lower(params).compile()  # the bench protocol
+    except Exception:
+        pass
+    for _ in range(max(warmup, 1)):
+        jax.block_until_ready(run(params))
+    total = measure_step_time(run, params, warmup=0, iters=max(iters, 20))
+    if names is not None:
+        tf = trace_layerwise_backward(
+            run, params, names, perm, iters=min(max(iters, 1), 5),
+            total_s=total, prefer="forward",
+        )
+        if tf is not None:
+            return TbProfile(tf, source="trace")
+    weights = backward_cost_weights(params, perm)
+    return TbProfile((total * w for w in weights), source="volume-prior")
+
+
+# ---------------------------------------------------------------------------
+# Layer-profile persistence (tb_profile.json and calibrate --forward's
+# output). Version history:
+#   1 — unstamped legacy: backward only ({tb_s, arrival_names, total_s,
+#       source});
+#   2 — adds schema_version and the optional forward timeline (tf_s,
+#       tf_total_s, tf_source) the cross-step solver consumes.
+# ---------------------------------------------------------------------------
+
+LAYER_PROFILE_SCHEMA_VERSION = 2
+
+
+def load_layer_profile(path: str) -> dict:
+    """Read a persisted layer profile (tb_profile.json format).
+
+    Returns the dict with `tb_s` and `tf_s` both present: a v1/legacy file
+    (or a v2 file written before any forward benchmark ran) has no
+    forward times, so `tf_s` defaults to ZEROS with a logged warning —
+    "forward times defaulted to 0 — rs_fwd_ag disabled" — instead of a
+    KeyError; a zero forward timeline makes the cross-step simulate see
+    no forward compute to hide gathers behind, so no rs_fwd_ag schedule
+    can win on it. Unknown future versions are rejected (the calibration
+    profiles' `check_schema_version` convention)."""
+    import json
+    import logging
+
+    from mgwfbp_tpu.parallel.costmodel import check_schema_version
+
+    with open(path) as f:
+        d = json.load(f)
+    check_schema_version(
+        d, path=path,
+        supported=(1, LAYER_PROFILE_SCHEMA_VERSION),
+        what="layer profile",
+    )
+    if not d.get("tf_s"):
+        logging.getLogger("mgwfbp.profiling").warning(
+            "%s: forward times defaulted to 0 — rs_fwd_ag disabled "
+            "(re-profile with `python -m mgwfbp_tpu.calibrate --forward "
+            "--model <dnn>` or a fresh training run to measure them)",
+            path,
+        )
+        d["tf_s"] = [0.0] * len(d.get("tb_s", []))
+        d.setdefault("tf_source", "absent")
+    return d
 
 
 def trace_group_times(

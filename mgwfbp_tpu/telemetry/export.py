@@ -30,6 +30,7 @@ from mgwfbp_tpu.telemetry.events import events_of
 _TID_STEPS = 0
 _TID_BACKWARD = 1
 _TID_OPTIMIZER = 2
+_TID_FORWARD = 3  # cross-step (rs_fwd_ag) regimes only
 _TID_GROUP0 = 10
 _PID = 1
 
@@ -81,6 +82,11 @@ def chrome_trace(records: list[dict]) -> dict:
         _meta("optimizer", _PID, _TID_OPTIMIZER, kind="thread_name"),
     ]
     snap, group_rows = latest_snapshot(records)
+    cross_step = snap is not None and float(snap.get("tf_total_s", 0.0)) > 0.0
+    if cross_step:
+        trace.append(_meta(
+            "forward", _PID, _TID_FORWARD, kind="thread_name",
+        ))
     for r in group_rows:
         gi = int(r["group"])
         trace.append(_meta(
@@ -97,17 +103,59 @@ def chrome_trace(records: list[dict]) -> dict:
         if snap is None:
             continue
         # scale the replayed model timeline (backward + comm + optimizer
-        # tail) into this step's real span, so sub-spans nest inside it
+        # tail) into this step's real span, so sub-spans nest inside it.
+        # Cross-step regimes replay STEP-anchored (forward first, then
+        # backward; the deferred-AG legs render on the forward region —
+        # in steady state every step's opening forward IS the previous
+        # step's "next forward"); in-step regimes stay backward-anchored.
         step_model_s = max(float(snap.get("step_s", 0.0)), 1e-12)
         scale = (dur / 1e6) / step_model_s
         tb_total = float(snap.get("tb_total_s", 0.0))
+        # the backward anchors where the replayed forward REGION ends —
+        # fwd_end_s includes AG-deadline stalls, so group RS spans (whose
+        # starts were computed against that backward window) stay in sync
+        # with the drawn backward even when a deferred gather stalled the
+        # forward; the forward span covers the whole region incl. stalls
+        fwd_end = 0.0
+        if cross_step:
+            fwd_end = max(
+                float(snap.get("fwd_end_s", 0.0)),
+                float(snap.get("tf_total_s", 0.0)),
+            )
+            trace.append(_span(
+                "forward", _TID_FORWARD, ts, fwd_end * scale * 1e6,
+            ))
         trace.append(_span(
-            "backward", _TID_BACKWARD, ts, tb_total * scale * 1e6,
+            "backward", _TID_BACKWARD, ts + fwd_end * scale * 1e6,
+            tb_total * scale * 1e6,
         ))
         for r in group_rows:
             gi = int(r["group"])
+            ag_s = float(r.get("ag_s", 0.0))
+            label = f"group {gi:04d} ({r.get('attribution', '?')})"
+            if ag_s > 0.0:
+                # the RS leg (start_s is already step-anchored) ...
+                trace.append(_span(
+                    f"{label} RS", _TID_GROUP0 + gi,
+                    ts + float(r["start_s"]) * scale * 1e6,
+                    (float(r["comm_s"]) - ag_s) * scale * 1e6,
+                    args={
+                        "nbytes": r.get("nbytes"),
+                        "hidden_s": r.get("hidden_s"),
+                        "exposed_s": r.get("exposed_s"),
+                    },
+                ))
+                # ... and the deferred AG leg on the forward region
+                trace.append(_span(
+                    f"{label} deferred AG (prev step's gather)",
+                    _TID_GROUP0 + gi,
+                    ts + float(r.get("ag_start_s", 0.0)) * scale * 1e6,
+                    ag_s * scale * 1e6,
+                    args={"nbytes": r.get("nbytes")},
+                ))
+                continue
             trace.append(_span(
-                f"group {gi:04d} ({r.get('attribution', '?')})",
+                label,
                 _TID_GROUP0 + gi,
                 ts + float(r["start_s"]) * scale * 1e6,
                 float(r["comm_s"]) * scale * 1e6,

@@ -47,8 +47,15 @@ class GroupOverlap:
     nbytes: int  # bucket payload on the wire
     start_s: float  # link-timeline start (ready[max member], link free)
     comm_s: float  # collective duration (measured or predicted)
-    hidden_s: float  # portion overlapping backward compute
-    exposed_s: float  # portion after backward end (critical path)
+    hidden_s: float  # portion overlapping compute (backward; + forward
+    # for the cross-step deferred-AG leg)
+    exposed_s: float  # portion on the critical path
+    # cross-step (rs_fwd_ag) only: the deferred all-gather leg, which
+    # executes during the NEXT step's forward. ag_start_s is anchored at
+    # that step's start; comm_s above is the rs+ag TOTAL and start_s the
+    # reduce-scatter leg's (step-anchored) start. Zero on in-step rows.
+    ag_start_s: float = 0.0
+    ag_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +66,14 @@ class OverlapSummary:
     tb_total_s: float  # backward compute total (sum of tb)
     groups: tuple[GroupOverlap, ...]
     attribution: str  # 'trace' | 'cost-model'
+    # forward compute total — nonzero only for the cross-step (rs_fwd_ag)
+    # regime, whose replayed timeline starts at the FORWARD (deferred AGs
+    # hide behind it); in-step regimes replay backward-anchored as before
+    tf_total_s: float = 0.0
+    # where the replayed forward REGION ends (tf_total_s + AG-deadline
+    # stalls) = where the backward begins; renderers anchor on this so a
+    # stalled forward never desynchronizes the backward vs the RS spans
+    fwd_end_s: float = 0.0
 
     @property
     def comm_s(self) -> float:
@@ -82,16 +97,23 @@ class OverlapSummary:
 
     @property
     def timeline_end_s(self) -> float:
-        """End of the replayed bwd+comm timeline (export's render span)."""
-        last_comm = max((g.start_s + g.comm_s for g in self.groups),
-                        default=0.0)
-        return max(self.tb_total_s, last_comm)
+        """End of the replayed compute+comm timeline (export's render
+        span). Cross-step rows count only their RS leg here (comm_s -
+        ag_s): the AG leg lives at the timeline's start."""
+        last_comm = max(
+            (g.start_s + (g.comm_s - g.ag_s) for g in self.groups),
+            default=0.0,
+        )
+        fwd = max(self.fwd_end_s, self.tf_total_s)
+        return max(fwd + self.tb_total_s, last_comm)
 
     def to_event_fields(self) -> dict:
         """The aggregate `overlap` telemetry record's payload."""
         return {
             "step_s": float(self.step_s),
             "tb_total_s": float(self.tb_total_s),
+            "tf_total_s": float(self.tf_total_s),
+            "fwd_end_s": float(self.fwd_end_s),
             "comm_s": float(self.comm_s),
             "hidden_s": float(self.hidden_s),
             "exposed_s": float(self.exposed_s),
@@ -102,9 +124,11 @@ class OverlapSummary:
         }
 
     def group_event_fields(self, step: int) -> list[dict]:
-        """One `comm_group` telemetry record payload per merge group."""
-        return [
-            {
+        """One `comm_group` telemetry record payload per merge group
+        (cross-step rows add the deferred-AG leg's span fields)."""
+        out = []
+        for g in self.groups:
+            fields = {
                 "step": int(step),
                 "group": g.group,
                 "nbytes": int(g.nbytes),
@@ -114,8 +138,11 @@ class OverlapSummary:
                 "exposed_s": float(g.exposed_s),
                 "attribution": self.attribution,
             }
-            for g in self.groups
-        ]
+            if g.ag_s > 0.0:
+                fields["ag_start_s"] = float(g.ag_start_s)
+                fields["ag_s"] = float(g.ag_s)
+            out.append(fields)
+        return out
 
 
 def attribute_overlap(
@@ -160,6 +187,75 @@ def attribute_overlap(
     return out
 
 
+def attribute_overlap_cross_step(
+    groups: Sequence[Sequence[int]],
+    tb: Sequence[float],
+    tf: Sequence[float],
+    rs_s: Sequence[float],
+    ag_s: Sequence[float],
+    nbytes: Sequence[int],
+) -> tuple[list[GroupOverlap], float]:
+    """The cross-step (rs_fwd_ag) replay: each group's comm splits into a
+    deferred all-gather leg racing the FORWARD timeline (issued in
+    forward-consumption order — reverse arrival — each gated by its first
+    consuming layer's AG deadline) and a reduce-scatter leg racing the
+    BACKWARD (the solver's taoc recurrence, offset to the forward's end).
+    hidden = AG time inside the forward window + RS time inside the
+    backward window; everything else is exposed — the overlap-efficiency
+    headline stays honest about which side hid what. All times are
+    step-anchored (0 = forward begin), unlike the in-step replay's
+    backward anchor; `OverlapSummary.tf_total_s` marks the regime.
+
+    Returns (rows, fwd_end_s): fwd_end_s is where the forward REGION
+    actually ends — sum(tf) plus any AG-deadline stall — i.e. where the
+    backward the RS starts were computed against begins; renderers must
+    anchor the backward there, not at sum(tf)."""
+    n = len(groups)
+    if any(len(x) != n for x in (rs_s, ag_s, nbytes)):
+        raise ValueError(
+            f"groups/rs_s/ag_s/nbytes disagree: {n}/{len(rs_s)}/"
+            f"{len(ag_s)}/{len(nbytes)}"
+        )
+    tf_total = float(np.sum(np.asarray(tf, np.float64))) if len(tf) else 0.0
+    # forward phase replay (simulate_cross_step's recurrence)
+    link = 0.0
+    fwd = 0.0
+    ag_starts = [0.0] * n
+    for gi in reversed(range(n)):
+        ag_starts[gi] = link
+        link += float(ag_s[gi])
+        fwd = max(fwd, link) + float(
+            sum(tf[i] for i in groups[gi]) if len(tf) else 0.0
+        )
+    fwd_end = max(fwd, tf_total)
+    # backward phase replay, offset to the forward's end; the RS link
+    # opens once the AG queue drained (a comm-bound tail can outlive the
+    # forward compute)
+    ready = fwd_end + np.cumsum(np.asarray(tb, dtype=np.float64))
+    bwd_end = float(ready[-1]) if len(ready) else fwd_end
+    link_free = max(link, fwd_end)
+    out: list[GroupOverlap] = []
+    for gi, g in enumerate(groups):
+        t_ag = float(ag_s[gi])
+        t_rs = float(rs_s[gi])
+        hidden_ag = min(max(fwd_end - ag_starts[gi], 0.0), t_ag)
+        ready_at = float(ready[max(g)]) if len(g) and len(ready) else fwd_end
+        rs_start = max(link_free, ready_at)
+        hidden_rs = min(max(bwd_end - rs_start, 0.0), t_rs)
+        out.append(GroupOverlap(
+            group=gi,
+            nbytes=int(nbytes[gi]),
+            start_s=rs_start,
+            comm_s=t_rs + t_ag,
+            hidden_s=hidden_rs + hidden_ag,
+            exposed_s=(t_rs - hidden_rs) + (t_ag - hidden_ag),
+            ag_start_s=ag_starts[gi],
+            ag_s=t_ag,
+        ))
+        link_free = rs_start + t_rs
+    return out, fwd_end
+
+
 def group_comm_times(
     reducer,
     cost_model,
@@ -195,16 +291,47 @@ def summarize(
     tb: Sequence[float],
     step_s: float,
     measured: Optional[Sequence[float]] = None,
+    tf: Optional[Sequence[float]] = None,
 ) -> OverlapSummary:
     """Full overlap accounting for one live schedule regime.
 
     tb is the arrival-ordered per-layer backward profile (measured, or the
     size prior the solver fell back to); step_s the measured seconds per
-    optimizer step the snapshot describes.
+    optimizer step the snapshot describes. For a cross-step (rs_fwd_ag)
+    reducer, `tf` is the forward profile its deferred-AG legs race
+    (defaults to `solver.forward_prior_tf(tb)`); per-group comm — trace
+    totals cover BOTH legs of a group's scope — splits between the legs in
+    the cost model's phase proportions (`solver.cross_step_phase_costs`).
     """
     comm, nbytes, attribution = group_comm_times(
         reducer, cost_model, measured
     )
+    if getattr(reducer, "comm_op", "all_reduce") == "rs_fwd_ag":
+        from mgwfbp_tpu.parallel.solver import (
+            cross_step_phase_costs,
+            forward_prior_tf,
+        )
+
+        if tf is None:
+            tf = forward_prior_tf(tb)
+        rs_c, ag_c = cross_step_phase_costs(cost_model)
+        rs_s, ag_s = [], []
+        for t, b in zip(comm, nbytes):
+            r, a = rs_c(b), ag_c(b)
+            frac = r / max(r + a, 1e-30)
+            rs_s.append(t * frac)
+            ag_s.append(t * (1.0 - frac))
+        rows, fwd_end = attribute_overlap_cross_step(
+            reducer.layout.groups, tb, tf, rs_s, ag_s, nbytes
+        )
+        return OverlapSummary(
+            step_s=float(step_s),
+            tb_total_s=float(sum(float(t) for t in tb)),
+            tf_total_s=float(sum(float(t) for t in tf)),
+            fwd_end_s=float(fwd_end),
+            groups=tuple(rows),
+            attribution=attribution,
+        )
     rows = attribute_overlap(reducer.layout.groups, tb, comm, nbytes)
     return OverlapSummary(
         step_s=float(step_s),

@@ -282,6 +282,20 @@ def make_train_step(
     stays device-sharded across steps: its buffers ride in/out of the
     shard_map with P(data_axes) specs instead of replicated P().
 
+    A reducer built with comm_op='rs_fwd_ag' (cross-step pipelining, the
+    DeAR decomposition) changes the step's PARAM contract as well:
+    state.params is the reducer's `ShardedParams` carry — per-merge-group
+    1/world flat shards, device-sharded between steps like the rs_opt_ag
+    opt state. The step's FORWARD begins by all-gathering each group's
+    carried shard just-in-time before its first consuming layer (early
+    forward layers gather while later groups' gathers are still in
+    flight), and its backward ends with the reduce-scatter + fused shard
+    update whose all-gather is DEFERRED into the next step — the updated
+    shards simply ride out as carried state. Per step the math is
+    identical to rs_opt_ag (same RS, same shard update, same values
+    gathered); only the gather's position moves across the step boundary,
+    off the backward-side critical path and onto the next forward's.
+
     seq_axis: sequence-parallel mesh axis for lm models whose time dimension
     is sharded (ring attention, parallel.ringattn). Batch x/y get spec
     P(None, data, seq); gradients/metrics reduce over BOTH axes (each seq
@@ -312,18 +326,37 @@ def make_train_step(
     sharded_opt = (
         reducer is not None and reducer.comm_op == "rs_opt_ag"
     )
+    cross_step = (
+        reducer is not None and reducer.comm_op == "rs_fwd_ag"
+    )
     # state specs: everything replicated EXCEPT the sharded opt-state
     # buffers on the rs_opt_ag path (P over the reduction axes, matching
-    # the shard each device's reduce-scatter owns)
+    # the shard each device's reduce-scatter owns); the cross-step path
+    # additionally carries PARAMS as per-group shards
     if sharded_opt:
         state_spec = TrainState(
             step=P(), params=P(), batch_stats=P(),
+            opt_state=reducer.optim.partition_spec(), rng=P(),
+        )
+    elif cross_step:
+        state_spec = TrainState(
+            step=P(), params=reducer.optim.params_partition_spec(),
+            batch_stats=P(),
             opt_state=reducer.optim.partition_spec(), rng=P(),
         )
     else:
         state_spec = P()
 
     def per_device(state: TrainState, batch, carry):
+        # cross-step: the forward half — gather each group's carried param
+        # shard under its mgwfbp_groupNNNN scope, in forward-consumption
+        # order, so XLA overlaps later groups' gathers with earlier
+        # layers' forward compute (the deferred AGs of the PREVIOUS
+        # step's reduce-scatters landing here is the whole point)
+        if cross_step:
+            params = reducer.gather_params(state.params)
+        else:
+            params = state.params
         step_rng = jax.random.fold_in(state.rng, state.step)
         # decorrelate dropout across data-parallel members
         for ax in data_axes:
@@ -336,7 +369,7 @@ def make_train_step(
         def micro_grads(bstats, mcarry, micro_batch, micro_idx):
             # distinct dropout mask per micro-step
             micro_rng = jax.random.fold_in(step_rng, micro_idx)
-            return g_fn(state.params, bstats, micro_batch, micro_rng, mcarry)
+            return g_fn(params, bstats, micro_batch, micro_rng, mcarry)
 
         def micro(acc, xs):
             micro_batch, micro_idx = xs
@@ -364,7 +397,7 @@ def make_train_step(
                 state.batch_stats, carry, last_batch, jnp.int32(0)
             )
         else:
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
             zero_metrics = {
                 "loss": jnp.zeros(()),
                 **({"accuracy": jnp.zeros(())} if meta.task == "classify" else {}),
@@ -390,18 +423,26 @@ def make_train_step(
         # grad reductions live under the reducer's per-group scopes (or
         # "flat_grad_reduce"); the metrics/BN-stats pmeans are declared
         # auxiliary so the verifier can tell them from hot-path strays.
-        if sharded_opt:
+        if sharded_opt or cross_step:
             if grad_guard:
                 # reduced grads never materialize on this path; count the
                 # local grads — non-finites survive the reduce-scatter, so
                 # the pmean'd count is the same zero/non-zero signal
                 with jax.named_scope("finite_check"):
                     metrics["grads_nonfinite"] = _nonfinite_count(grads)
-            # rs_opt_ag: reduction and optimizer are one fused phase —
-            # params come back already updated, tx.update never runs
-            new_params, new_opt_state = reducer.reduce_and_update(
-                grads, state.params, state.opt_state
-            )
+            if cross_step:
+                # rs_fwd_ag: reduce-scatter + shard update only — the
+                # all-gather is deferred; the updated shards carry out of
+                # the step and the NEXT forward gathers them
+                new_params, new_opt_state = reducer.reduce_and_defer(
+                    grads, state.params, state.opt_state
+                )
+            else:
+                # rs_opt_ag: reduction and optimizer are one fused phase —
+                # params come back already updated, tx.update never runs
+                new_params, new_opt_state = reducer.reduce_and_update(
+                    grads, state.params, state.opt_state
+                )
         else:
             if reducer is not None:
                 grads = reducer(grads)
@@ -419,7 +460,7 @@ def make_train_step(
         if jax.tree_util.tree_leaves(bstats):
             with jax.named_scope("bstats_reduce"):
                 bstats = lax.pmean(bstats, red_axes)
-        if not sharded_opt:
+        if not (sharded_opt or cross_step):
             updates, new_opt_state = tx.update(
                 grads, state.opt_state, state.params
             )

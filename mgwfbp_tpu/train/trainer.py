@@ -51,6 +51,18 @@ from mgwfbp_tpu.utils.faults import FaultPlan, Preempted
 from mgwfbp_tpu.utils.logging import get_logger
 
 
+def derive_agree_interval(step_s: float, grace_s: float = 30.0) -> int:
+    """Drain-agreement cadence from a measured step time (ROADMAP PR-6
+    follow-up b): the group consults `agree_any` every N-th step, so a
+    preemption drain lags by at most N steps — budget HALF the preemption
+    grace window for that lag (the other half covers the in-flight step
+    plus the drain checkpoint itself). Clamped to [1, 1000]; explicit
+    MGWFBP_AGREE_INTERVAL values are always authoritative over this."""
+    if step_s <= 0.0:
+        return 1
+    return int(min(max(grace_s * 0.5 / step_s, 1.0), 1000.0))
+
+
 class _RollbackRequested(Exception):
     """Internal: K consecutive non-finite steps — unwind train_epoch so
     _fit_epochs can restore the last checkpoint and continue from there."""
@@ -176,7 +188,16 @@ class Trainer:
             self._example_input(),
             self.tx,
         )
+        # canonical param pytree shapes/dtypes: the shape source for layer
+        # specs, reducer builds, and checkpoint templates — on the
+        # cross-step (rs_fwd_ag) path the live state.params is the sharded
+        # carry and no longer LOOKS like the model's param tree
+        self._params_template = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self.state.params,
+        )
         self._tb_cache = None  # measured backward profile, reused on resize
+        self._tf_cache = None  # measured forward profile (rs_fwd_ag)
         # trace-attributed per-group comm seconds (layout order) for the
         # LIVE schedule, when a profiler trace has measured them (autotune,
         # or the opt-in MGWFBP_TELEMETRY_TRACE snapshot); telemetry's
@@ -189,22 +210,37 @@ class Trainer:
         self._eval_step_compiled = False
         self._profile_backward_enabled = profile_backward
         self.reducer = self._build_reducer(profile_backward)
-        if self._sharded_opt:
-            # rs_opt_ag: the optimizer state lives as 1/world bucket shards
-            # on each device from here on; it only returns to the
-            # replicated optax form at checkpoint boundaries (gather) and
-            # elastic resizes (gather -> re-scatter on the new layout)
+        if self._sharded_opt or self._cross_step:
+            # rs_opt_ag / rs_fwd_ag: the optimizer state lives as 1/world
+            # bucket shards on each device from here on; it only returns
+            # to the replicated optax form at checkpoint boundaries
+            # (gather) and elastic resizes (gather -> re-scatter on the
+            # new layout)
             self.state = self.state.replace(
                 opt_state=self.reducer.optim.init()
             )
             self.log.info(
-                "sharded optimizer (rs_opt_ag): opt-state %d B/device vs "
+                "sharded optimizer (%s): opt-state %d B/device vs "
                 "%d B replicated (%.2fx reduction over %d workers)",
+                self.reducer.comm_op,
                 self.reducer.optim.state_bytes_per_device(),
                 self.reducer.optim.replicated_state_bytes(),
                 self.reducer.optim.replicated_state_bytes()
                 / max(self.reducer.optim.state_bytes_per_device(), 1),
                 self.reducer.optim.world,
+            )
+        if self._cross_step:
+            # rs_fwd_ag: params too become the cross-step carry — per-group
+            # 1/world shards whose all-gather lands in the NEXT step's
+            # forward; the canonical replicated tree exists only at
+            # checkpoint/eval boundaries (gather) from here on
+            self.state = self.state.replace(
+                params=self.reducer.optim.scatter_params(self.state.params)
+            )
+            self.log.info(
+                "cross-step pipelining (rs_fwd_ag): %d group gather(s) "
+                "deferred into the next step's forward",
+                self.reducer.layout.num_groups,
             )
         if self.reducer is not None:
             detail = self.reducer.schedule.policy_detail
@@ -245,6 +281,20 @@ class Trainer:
             raise ValueError(
                 f"MGWFBP_AGREE_INTERVAL={raw_interval!r} is not an integer"
             ) from None
+        # unset -> auto: once a step time has been measured, derive the
+        # interval from it vs the MGWFBP_PREEMPT_GRACE_S budget (default
+        # 30 s) and broadcast process 0's choice — the cadence gates a
+        # COLLECTIVE, so it must be bit-identical across the group, and
+        # per-process wall clocks are not. Explicit values stay
+        # authoritative (no derivation runs).
+        self._agree_interval_auto = not raw_interval
+        raw_grace = (os.environ.get("MGWFBP_PREEMPT_GRACE_S") or "").strip()
+        try:
+            self._preempt_grace_s = float(raw_grace or "30")
+        except ValueError:
+            raise ValueError(
+                f"MGWFBP_PREEMPT_GRACE_S={raw_grace!r} is not a number"
+            ) from None
         self._signals_armed = False
         self._resume_epoch: Optional[int] = None  # mid-epoch resume target
         self._resume_skip_steps = 0  # optimizer steps already done there
@@ -276,17 +326,46 @@ class Trainer:
             and self.reducer.comm_op == "rs_opt_ag"
         )
 
+    @property
+    def _cross_step(self) -> bool:
+        """True when params AND opt state are device-sharded between steps
+        (rs_fwd_ag: the cross-step carry — each group's all-gather lands in
+        the next step's forward)."""
+        return (
+            getattr(self, "reducer", None) is not None
+            and self.reducer.comm_op == "rs_fwd_ag"
+        )
+
+    def _template_params(self):
+        """Full replicated zeros matching the canonical param pytree (the
+        interchange form's param template when the live params are carried
+        as cross-step shards)."""
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._params_template
+        )
+
     def _replicated_template_state(self):
-        """TrainState in checkpoint-interchange form: the replicated optax
-        opt_state structure both comm paths save/restore through."""
-        if not self._sharded_opt:
+        """TrainState in checkpoint-interchange form: full replicated
+        params + the replicated optax opt_state structure every comm path
+        saves/restores through."""
+        if not (self._sharded_opt or self._cross_step):
             return self.state
-        return self.state.replace(opt_state=self.tx.init(self.state.params))
+        state = self.state
+        if self._cross_step:
+            state = state.replace(params=self._template_params())
+        return state.replace(opt_state=self.tx.init(state.params))
 
     def _to_checkpoint_state(self, state):
-        """Gather the sharded opt state into the replicated optax form."""
-        if not self._sharded_opt:
+        """Gather sharded state (opt state; cross-step also params) into
+        the replicated interchange form."""
+        if not (self._sharded_opt or self._cross_step):
             return state
+        if self._cross_step:
+            state = state.replace(
+                params=self.reducer.optim.gather_params(
+                    state.params, self._params_template
+                )
+            )
         return state.replace(
             opt_state=self.reducer.optim.gather(
                 state.opt_state, self.tx, state.params
@@ -294,14 +373,20 @@ class Trainer:
         )
 
     def _from_checkpoint_state(self, state):
-        """Scatter a replicated optax opt state onto the current layout."""
-        if not self._sharded_opt:
+        """Scatter a replicated interchange state onto the current layout
+        (opt state first — its scatter reads the still-full params)."""
+        if not (self._sharded_opt or self._cross_step):
             return state
-        return state.replace(
+        state = state.replace(
             opt_state=self.reducer.optim.scatter(
                 state.opt_state, state.params
             )
         )
+        if self._cross_step:
+            state = state.replace(
+                params=self.reducer.optim.scatter_params(state.params)
+            )
+        return state
 
     # ------------------------------------------------------------------
     def _build_loaders(self):
@@ -485,10 +570,12 @@ class Trainer:
 
     def _layer_specs(self) -> list:
         """Arrival-ordered LayerSpecs of the live reducer's layer set
-        (shared by the autotuner's frontier and the overlap tb prior)."""
+        (shared by the autotuner's frontier and the overlap tb prior).
+        Shapes come from the canonical param TEMPLATE — the live
+        state.params may be the cross-step sharded carry."""
         from mgwfbp_tpu.parallel.solver import LayerSpec
 
-        leaves = jax.tree_util.tree_leaves(self.state.params)
+        leaves = jax.tree_util.tree_leaves(self._params_template)
         arr = [leaves[j] for j in self.reducer.perm]
         return [
             LayerSpec(
@@ -530,9 +617,14 @@ class Trainer:
             self.reducer.layout.num_groups
         ):
             measured = None  # traced under a since-replaced schedule
+        tf = (
+            list(self._tf_cache)
+            if self._cross_step and self._tf_cache is not None
+            else None  # summarize falls back to the tb/2 forward prior
+        )
         summary = tel.summarize(
             self.reducer, cost_model, self._overlap_tb(), step_s,
-            measured=measured,
+            measured=measured, tf=tf,
         )
         self._emit_event(
             "overlap", step=int(step), epoch=int(epoch),
@@ -879,6 +971,7 @@ class Trainer:
             if self._tb_cache is not None
             else size_prior_tb(specs, cost_model)
         )
+        tf = list(self._tf_cache) if self._tf_cache is not None else None
         # "both comm_op lowerings where state permits": a sparsifying
         # compressor replaces the bucket collective, so only the configured
         # all_reduce path is raceable under it
@@ -889,6 +982,7 @@ class Trainer:
         )
         candidates = at.build_candidates(
             specs, tb, cost_model, comm_ops,
+            tf=tf,
             max_candidates=max(int(cfg.autotune_candidates), 1),
             incumbent=(self.reducer.schedule.groups, cfg.comm_op),
         )
@@ -974,8 +1068,8 @@ class Trainer:
                     }
                     self.cost_model = new_model
                     resolved = build_schedule(
-                        specs, tb, policy="auto", cost_model=new_model,
-                        comm_op=cfg.comm_op,
+                        specs, tb, tf=tf, policy="auto",
+                        cost_model=new_model, comm_op=cfg.comm_op,
                     )
                     shape = tuple(tuple(g) for g in resolved.groups)
                     if (cfg.comm_op, shape) not in raced_shapes:
@@ -1114,18 +1208,21 @@ class Trainer:
             axes = axes + (self.seq_axis,)
         comm_dtype = jnp.dtype(cfg.comm_dtype) if cfg.comm_dtype else None
         return make_merged_allreduce(
-            self.state.params,
+            self._params_template,
             axis_name=axes,
             policy="auto",  # only sets the tb fallback; `groups` wins
             groups=groups,
             policy_detail=detail,
             tb=self._tb_cache,
+            tf=self._tf_cache,
             cost_model=getattr(self, "cost_model", None),
             comm_dtype=comm_dtype,
             compressor=self._compressor,
             comm_op=comm_op,
             optim_spec=(
-                self.optim_spec if comm_op == "rs_opt_ag" else None
+                self.optim_spec
+                if comm_op in ("rs_opt_ag", "rs_fwd_ag")
+                else None
             ),
             world_size=self.data_size * self.seq_size,
         )
@@ -1224,7 +1321,7 @@ class Trainer:
                 )
             args.append(self.carry)
         closed = jax.make_jaxpr(self.train_step)(*args)
-        leaves = jax.tree_util.tree_leaves(self.state.params)
+        leaves = jax.tree_util.tree_leaves(self._params_template)
         arr = [leaves[j] for j in self.reducer.perm]
         tag = self.reducer.schedule.policy_detail or self.config.policy
         return verify_jaxpr_against_reducer(
@@ -1452,13 +1549,23 @@ class Trainer:
                 "(--dcn-slices > 1) and no sequence parallelism; "
                 f"got dcn={self.dcn_size}, seq={self.seq_size}"
             )
+        if cfg.comm_op == "rs_fwd_ag" and jax.process_count() > 1:
+            # the cross-step carry's interchange form (checkpoints, eval,
+            # autotune swaps) gathers shards host-side, which needs every
+            # buffer locally addressable; multi-host needs a collective
+            # gather seam first (ROADMAP follow-up)
+            raise ValueError(
+                "--comm-op rs_fwd_ag is single-process (multi-device) for "
+                "now: the cross-step param carry's host gather/scatter is "
+                "not multi-host capable yet"
+            )
         if cfg.policy in ("none", "xla"):
-            if cfg.comm_op == "rs_opt_ag":
+            if cfg.comm_op in ("rs_opt_ag", "rs_fwd_ag"):
                 # the sharded optimizer NEEDS the bucketed lowering (it
                 # runs inside the per-group RS/AG seam); silently falling
                 # back to replicated updates would misreport memory wins
                 raise ValueError(
-                    "--comm-op rs_opt_ag requires a merge policy "
+                    f"--comm-op {cfg.comm_op} requires a merge policy "
                     "(mgwfbp/auto/threshold/single/wfbp); policy "
                     f"{cfg.policy!r} issues no bucket collectives"
                 )
@@ -1477,11 +1584,11 @@ class Trainer:
                 "(policy %s inert, reference single-path parity)", cfg.policy,
             )
             return None
-        if cfg.comm_op == "rs_opt_ag" and cfg.compressor not in (
+        if cfg.comm_op in ("rs_opt_ag", "rs_fwd_ag") and cfg.compressor not in (
             None, "", "none"
         ):
             raise ValueError(
-                "--comm-op rs_opt_ag cannot combine with --compressor "
+                f"--comm-op {cfg.comm_op} cannot combine with --compressor "
                 "(the shard update needs the dense reduction)"
             )
         if cfg.comm_profile:
@@ -1518,12 +1625,23 @@ class Trainer:
             cost_model = lookup_alpha_beta(cfg.connection, self.data_size)
         self.cost_model = cost_model  # introspection (logs, tests)
         tb = None
+        tf = None
         if cfg.policy in ("mgwfbp", "auto") and profile_backward:
             if self._tb_cache is None:
                 self._tb_cache = self._profile_backward()
             # tb is per-device backward time at the per-device batch, which
             # weak scaling holds constant — reusable across worker resizes
             tb = self._tb_cache
+            if cfg.comm_op == "rs_fwd_ag":
+                # the cross-step simulate prices deferred all-gathers
+                # against the FORWARD timeline; only this comm_op ever
+                # consumes it — allowed_comm_ops adds rs_fwd_ag candidates
+                # to a race only when it IS the configured lowering, so
+                # other runs must not pay the extra benchmark (falls back
+                # to solver.forward_prior_tf when the benchmark fails)
+                if self._tf_cache is None:
+                    self._tf_cache = self._profile_forward()
+                tf = self._tf_cache
         comm_dtype = (
             jnp.dtype(cfg.comm_dtype) if cfg.comm_dtype else None
         )
@@ -1538,8 +1656,8 @@ class Trainer:
             from mgwfbp_tpu.parallel.costmodel import choose_density
 
             n_elems = sum(
-                int(v.size)
-                for v in jax.tree_util.tree_leaves(self.state.params)
+                int(np.prod(v.shape)) if v.shape else 1
+                for v in jax.tree_util.tree_leaves(self._params_template)
             )
             density = choose_density(
                 n_elems, self.data_size * self.seq_size, cost_model
@@ -1570,17 +1688,20 @@ class Trainer:
         if self.seq_axis is not None:
             axes = axes + (self.seq_axis,)
         return make_merged_allreduce(
-            self.state.params,
+            self._params_template,
             axis_name=axes,
             policy=cfg.policy,
             tb=tb,
+            tf=tf,
             cost_model=cost_model,
             threshold=cfg.threshold,
             comm_dtype=comm_dtype,
             compressor=compressor,
             comm_op=cfg.comm_op,
             optim_spec=(
-                self.optim_spec if cfg.comm_op == "rs_opt_ag" else None
+                self.optim_spec
+                if cfg.comm_op in ("rs_opt_ag", "rs_fwd_ag")
+                else None
             ),
             world_size=self.data_size * self.seq_size,
         )
@@ -1635,31 +1756,104 @@ class Trainer:
         )
         return tb
 
-    def _persist_tb(self, tb, names, perm) -> None:
-        """Persist the measured layer-wise backward profile next to the run's
-        logs (the comm profile's sibling — reference persists nothing, but
-        its measured layerwise_times are the solver's primary input,
-        dist_trainer.py:44-51, so ours are auditable on disk)."""
+    def _profile_forward(self) -> Optional[list[float]]:
+        """Layer-wise FORWARD benchmark (the backward benchmark's twin):
+        arrival-ordered per-layer forward seconds, feeding the cross-step
+        solver's AG-before-first-use deadlines. Broadcast from process 0
+        like tb, for the same schedule-divergence reason."""
+        from mgwfbp_tpu.parallel.allreduce import arrival_order
+        from mgwfbp_tpu.profiling import benchmark_trainer_forward
+
+        try:
+            batch = self._peek_batch()
+        except StopIteration:
+            return None
+        per_device = max(self.config.batch_size, 1)
+        batch = {k: v[:per_device] for k, v in batch.items()}
+        if self.seq_axis is not None:
+            batch = {
+                k: (v[:, : v.shape[1] // self.seq_size] if v.ndim >= 2 else v)
+                for k, v in batch.items()
+            }
+        paths = jax.tree_util.tree_flatten_with_path(self._params_template)[0]
+        names = [jax.tree_util.keystr(kp) for kp, _ in paths]
+        perm = arrival_order(len(names), names=names)
+        t0 = time.perf_counter()
+        params = self.state.params
+        from mgwfbp_tpu.parallel.allreduce import ShardedParams
+
+        if isinstance(params, ShardedParams):
+            # the benchmark forwards the canonical tree on ONE device
+            params = self.reducer.optim.gather_params(
+                params, self._params_template
+            )
+        try:
+            tf = benchmark_trainer_forward(
+                self.model, self.meta, params, self.state.batch_stats,
+                batch, perm, warmup=2, iters=10, names=names,
+                compute_dtype=self.compute_dtype,
+            )
+        except Exception as e:  # noqa: BLE001 — the forward profile is an
+            # input to a cost MODEL; the solver's tf prior (tb/2) is the
+            # documented fallback, not a crash
+            self.log.warning(
+                "forward benchmark failed (%s); rs_fwd_ag schedules fall "
+                "back to the tb/2 forward prior", e,
+            )
+            return None
+        source = getattr(tf, "source", "volume-prior")
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            from mgwfbp_tpu.profiling import TbProfile
+
+            tf_arr = multihost_utils.broadcast_one_to_all(
+                np.asarray(tf, np.float64)
+            )
+            tf = TbProfile((float(t) for t in tf_arr), source=source)
+        self._persist_tb(
+            self._tb_cache if self._tb_cache is not None else [],
+            names, perm, tf=tf,
+        )
+        self.log.info(
+            "forward benchmark: %.3g s total over %d tensors, "
+            "per-layer source=%s (%.1f s)",
+            sum(tf), len(tf), source, time.perf_counter() - t0,
+        )
+        return tf
+
+    def _persist_tb(self, tb, names, perm, tf=None) -> None:
+        """Persist the measured layer-wise backward (and, when measured,
+        forward) profile next to the run's logs (the comm profile's
+        sibling — reference persists nothing, but its measured
+        layerwise_times are the solver's primary input,
+        dist_trainer.py:44-51, so ours are auditable on disk). Stamped
+        schema_version=2 (tf_s added); `profiling.load_layer_profile`
+        migrates unstamped v1 files."""
         if not self.config.logdir:
             return
         import json
+
+        from mgwfbp_tpu.profiling import LAYER_PROFILE_SCHEMA_VERSION
 
         path = os.path.join(
             self.config.logdir, self.config.tag(), "tb_profile.json"
         )
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = {
+            "schema_version": LAYER_PROFILE_SCHEMA_VERSION,
+            "tb_s": list(tb),
+            "arrival_names": [names[j] for j in perm],
+            "total_s": sum(tb),
+            # which path produced the numbers: 'trace' (profiler
+            # attribution) or 'volume-prior' (numel-weight split)
+            "source": getattr(tb, "source", "volume-prior"),
+        }
+        if tf is not None:
+            doc["tf_s"] = list(tf)
+            doc["tf_total_s"] = sum(tf)
+            doc["tf_source"] = getattr(tf, "source", "volume-prior")
         with open(path, "w") as f:
-            json.dump(
-                {
-                    "tb_s": list(tb),
-                    "arrival_names": [names[j] for j in perm],
-                    "total_s": sum(tb),
-                    # which path produced the numbers: 'trace' (profiler
-                    # attribution) or 'volume-prior' (numel-weight split)
-                    "source": getattr(tb, "source", "volume-prior"),
-                },
-                f,
-            )
+            json.dump(doc, f)
 
     def _peek_batch(self) -> dict:
         self.bundle.train.set_epoch(0)
@@ -1843,6 +2037,7 @@ class Trainer:
             if self.iteration % log_interval == 0:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 dt = (time.time() - t_window) / max(window_iters, 1)
+                self._maybe_derive_agree_interval(dt)
                 global_batch = cfg.batch_size * self.data_size * nsteps
                 shown = {
                     k: v for k, v in metrics.items()
@@ -1906,6 +2101,29 @@ class Trainer:
     # guard bookkeeping, rollback. utils/faults.py owns the deterministic
     # injection plan; these methods own the live handling policy.
     # ------------------------------------------------------------------
+
+    def _maybe_derive_agree_interval(self, step_s: float) -> None:
+        """One-shot MGWFBP_AGREE_INTERVAL auto-derivation from the first
+        measured step-time window (multi-host only — single-process runs
+        never consult the interval). Process 0's derivation is broadcast:
+        the cadence gates a collective (`_agreed_preempt`'s agree_any), so
+        it must be bit-identical across the group and per-process wall
+        clocks are not. Fires at the first log window, which lands at the
+        same iteration on every process (MGWFBP_LOG_INTERVAL, like every
+        MGWFBP_* cadence var, must be group-uniform — the supervisor
+        exports one environment)."""
+        if not self._agree_interval_auto or coord.process_count() == 1:
+            return
+        self._agree_interval_auto = False  # one-shot
+        iv = derive_agree_interval(step_s, self._preempt_grace_s)
+        iv = int(coord.broadcast_flag(float(iv)))
+        self._agree_interval = max(iv, 1)
+        self.log.info(
+            "MGWFBP_AGREE_INTERVAL auto-derived: %d (measured %.4g s/step "
+            "vs %.3g s preemption grace; set MGWFBP_AGREE_INTERVAL to "
+            "override)",
+            self._agree_interval, step_s, self._preempt_grace_s,
+        )
 
     def _arm_signals(self) -> None:
         """SIGTERM/SIGINT -> graceful drain: finish the in-flight step,
@@ -2172,6 +2390,23 @@ class Trainer:
         )
         return self.start_epoch
 
+    def _eval_params(self):
+        """The canonical replicated params for host/eval consumers: the
+        live tree, or the cross-step carry gathered back into it."""
+        if not self._cross_step:
+            return self.state.params
+        return self.reducer.optim.gather_params(
+            self.state.params, self._params_template
+        )
+
+    def _eval_state(self):
+        """State view eval steps consume: replicated params (gathered from
+        the cross-step carry when needed); opt state is stripped by the
+        eval step itself."""
+        if not self._cross_step:
+            return self.state
+        return self.state.replace(params=self._eval_params(), opt_state=())
+
     def evaluate(self) -> dict:
         """Eval over the val loader (reference test(), dl_trainer.py:854-937).
 
@@ -2188,6 +2423,10 @@ class Trainer:
                 "fault injection: stalling %.3g s in eval", stall_s
             )
             time.sleep(stall_s)
+        # cross-step carry: eval consumes the canonical replicated params;
+        # gather the shards ONCE per evaluate() (the jitted eval step's
+        # in-spec is replicated P())
+        eval_state = self._eval_state()
         loader = self.bundle.val
         sums: dict[str, float] = {}
         wer_total, wer_n = 0.0, 0
@@ -2242,10 +2481,10 @@ class Trainer:
 
                 wd.beat("compile eval step", allow_s=COMPILE_ALLOW_S)
             if self.meta.has_carry:
-                metrics, carry = self.eval_step(self.state, batch, carry)
+                metrics, carry = self.eval_step(eval_state, batch, carry)
             elif self.meta.task == "ctc":
                 metrics, logits, out_lengths = self.eval_step(
-                    self.state, batch
+                    eval_state, batch
                 )
                 if fused_wer:
                     w, n = self._decode_wer_batch(
@@ -2254,7 +2493,7 @@ class Trainer:
                     wer_total += w
                     wer_n += n
             else:
-                metrics = self.eval_step(self.state, batch)
+                metrics = self.eval_step(eval_state, batch)
             self._eval_step_compiled = True
             for k, v in metrics.items():
                 # device-side accumulation: a float() here would pull one
@@ -2316,12 +2555,13 @@ class Trainer:
                 )
             )
         total, n = 0.0, 0
+        decode_params = self._eval_params()
         for bi, raw in enumerate(self.bundle.val):
             if max_batches is not None and bi >= max_batches:
                 break
             batch = self._to_model_batch(raw)
             logits, out_lengths = self._decode_forward(
-                self.state.params, self.state.batch_stats,
+                decode_params, self.state.batch_stats,
                 batch["x"], batch["input_lengths"],
             )
             hyps = greedy_decode(np.asarray(logits), np.asarray(out_lengths))
@@ -2510,9 +2750,14 @@ class Trainer:
             # counters). Optimizer state starts fresh — the reference never
             # saves it.
             pre = self.load_checkpoint(self.config.pretrain)
+            pre_params = pre.state.params
+            if self._cross_step:
+                # the live params are the sharded carry; re-scatter the
+                # restored canonical tree onto it
+                pre_params = self.reducer.optim.scatter_params(pre_params)
             self.state = self.state.replace(
                 step=pre.state.step,
-                params=pre.state.params,
+                params=pre_params,
                 batch_stats=pre.state.batch_stats,
             )
             self.start_epoch = pre.epoch + 1

@@ -107,13 +107,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kept-fraction for sparsifying compressors; 0 = "
                         "auto (cost-model chooser, may fall back to dense)")
     p.add_argument("--comm-op", dest="comm_op", default=None,
-                   choices=["all_reduce", "rs_ag", "hier", "rs_opt_ag"],
+                   choices=["all_reduce", "rs_ag", "hier", "rs_opt_ag",
+                            "rs_fwd_ag"],
                    help="bucket collective: monolithic all-reduce, "
                         "reduce-scatter + all-gather (DeAR-style), the "
                         "hierarchical two-level ICI+DCN lowering (requires "
-                        "--dcn-slices > 1), or reduce-scatter + SHARDED "
+                        "--dcn-slices > 1), reduce-scatter + SHARDED "
                         "optimizer update + param all-gather (ZeRO-1-style "
-                        "1/world optimizer state; same wire bytes as rs_ag)")
+                        "1/world optimizer state; same wire bytes as "
+                        "rs_ag), or rs_fwd_ag — the CROSS-STEP pipeline: "
+                        "rs_opt_ag whose param all-gather is deferred into "
+                        "the next step's forward, hiding comm behind "
+                        "forward compute too (params carried as 1/world "
+                        "shards; single-process only)")
     p.add_argument("--dcn-slices", dest="dcn_slices", type=int, default=None,
                    help="slices of a multi-slice pod: adds an outer "
                         "data-parallel mesh axis whose collectives cross "

@@ -45,8 +45,9 @@ def format_report(entry: dict) -> str:
         f"(model={entry.get('model')}, world={entry.get('world')}, "
         f"comm_op={entry.get('comm_op')}, dtype={entry.get('dtype')})"
     )
+    cross = " [cross-step]" if entry.get("comm_op") == "rs_fwd_ag" else ""
     lines.append(
-        f"committed winner: {entry.get('winner')} — "
+        f"committed winner: {entry.get('winner')}{cross} — "
         f"{len(entry.get('groups', []))} group(s), "
         f"measured {_fmt_s(entry.get('measured_step_s'))} s/step"
     )
@@ -58,8 +59,14 @@ def format_report(entry: dict) -> str:
         f"{'predicted_s':>12} {'measured_s':>12}"
     )
     for r in entry.get("race", []):
+        label = r.get("label", "?")
+        if r.get("comm_op") == "rs_fwd_ag":
+            # cross-step candidate: its AG legs ride the NEXT step's
+            # forward (one-step deferred gathers), priced by the
+            # two-phase simulate
+            label += " [cross-step]"
         lines.append(
-            f"  {r.get('label', '?'):<40} {r.get('num_groups', 0):>6} "
+            f"  {label:<40} {r.get('num_groups', 0):>6} "
             f"{str(r.get('verified', False)):>8} "
             f"{_fmt_s(r.get('predicted_total_s')):>12} "
             f"{_fmt_s(r.get('measured_step_s')):>12}"

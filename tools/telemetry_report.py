@@ -87,23 +87,42 @@ def format_report(records: list[dict]) -> str:
             f"overlap snapshot (step {snap.get('step')}, attribution="
             f"{snap.get('attribution')}):"
         )
+        cross = float(snap.get("tf_total_s", 0.0) or 0.0) > 0.0
         lines.append(
             f"  {'group':>5} {'bytes':>12} {'comm_s':>10} {'hidden_s':>10} "
             f"{'exposed_s':>10}"
+            + (f" {'ag_s':>10}" if cross else "")
         )
         for r in rows:
-            lines.append(
+            row = (
                 f"  {int(r['group']):>5} {int(r['nbytes']):>12} "
                 f"{_fmt_s(r['comm_s']):>10} {_fmt_s(r['hidden_s']):>10} "
                 f"{_fmt_s(r['exposed_s']):>10}"
             )
+            if cross:
+                # cross-step regime: ag_s is the deferred all-gather leg
+                # riding the NEXT step's forward
+                row += f" {_fmt_s(r.get('ag_s', 0.0)):>10}"
+            lines.append(row)
+        tail = (
+            f"(forward {_fmt_s(snap.get('tf_total_s'))} s, backward "
+            if cross
+            else "(backward "
+        )
         lines.append(
             f"  total comm {_fmt_s(snap.get('comm_s'))} s = hidden "
             f"{_fmt_s(snap.get('hidden_s'))} s + exposed "
             f"{_fmt_s(snap.get('exposed_s'))} s "
-            f"(backward {_fmt_s(snap.get('tb_total_s'))} s, step "
+            + tail
+            + f"{_fmt_s(snap.get('tb_total_s'))} s, step "
             f"{_fmt_s(snap.get('step_s'))} s)"
         )
+        if cross:
+            lines.append(
+                "  cross-step regime (rs_fwd_ag): each group's AG is "
+                "deferred into the next step's forward; hidden counts "
+                "both forward- and backward-side overlap"
+            )
         lines.append(
             f"overlap efficiency: {float(snap.get('efficiency', 0.0)):.4f} "
             "(hidden / total comm; 1.0 = fully hidden)"
