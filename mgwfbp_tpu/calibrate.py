@@ -36,6 +36,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "pure alpha-beta objective")
     p.add_argument("--no-overlap", action="store_true",
                    help="skip the comm/compute overlap-capability probe")
+    p.add_argument("--allgather", action="store_true",
+                   help="also sweep a tiled all-gather at the same payload "
+                        "sizes and fit ag_fraction — the measured RS/AG "
+                        "phase split the cross-step rs_fwd_ag solver uses "
+                        "instead of halving the full-collective predictor "
+                        "(persisted in the profile, schema v3; older "
+                        "profiles load with the historical 0.5 split)")
     p.add_argument("--gamma-total-log2", type=int, default=22,
                    help="fixed total payload for the gamma fit (log2 elems)")
     p.add_argument("--world-sizes", default=None,
@@ -93,6 +100,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
     from mgwfbp_tpu.profiling import (
+        fit_ag_fraction,
+        profile_allgather,
         profile_allreduce,
         profile_group_overhead,
         profile_overlap_capability,
@@ -124,6 +133,15 @@ def main(argv: Optional[list[str]] = None) -> int:
             # the rs_opt_ag update-in-the-middle term (ROADMAP PR-2
             # follow-up): rs_ag vs rs_opt_ag on an identical payload
             update_beta = profile_update_beta(mesh)
+        ag_fraction = 0.5
+        if args.allgather:
+            # measured RS/AG phase split (ROADMAP PR-7 follow-up b): a
+            # dedicated tiled-all-gather sweep at the SAME payload sizes;
+            # the median AG/full ratio replaces the halved-split prior
+            ag_prof = profile_allgather(
+                mesh, sizes=sizes, warmup=args.warmup, iters=args.iters
+            )
+            ag_fraction = fit_ag_fraction(prof, ag_prof)
         # the sampled curve (not just the 2-parameter fit) is the persisted
         # predictor: one flat beta cannot describe payload-dependent
         # per-byte cost (cache regimes on CPU, DMA pipelining on TPU)
@@ -135,6 +153,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             overlap=overlap,
             pack_beta=pack_beta,
             update_beta=update_beta,
+            ag_fraction=ag_fraction,
         )
         return model, prof, gsamples
 
@@ -163,6 +182,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                 alpha=ab.alpha, beta=ab.beta, gamma=measured.gamma,
                 overlap=measured.overlap, pack_beta=measured.pack_beta,
                 update_beta=measured.update_beta,
+                ag_fraction=measured.ag_fraction,
             )
         out_model = ProfileFamily(entries=entries)
         meta["measured_fields"] = {
@@ -190,6 +210,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             "overlap": measured.overlap,
             "pack_beta_s_per_byte": measured.pack_beta,
             "update_beta_s_per_byte": measured.update_beta,
+            "ag_fraction": measured.ag_fraction,
             "prior_extended": prior_sizes,
             "out": args.out,
         }
@@ -213,6 +234,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "overlap": model.overlap,
                 "pack_beta_s_per_byte": model.pack_beta,
                 "update_beta_s_per_byte": model.update_beta,
+                "ag_fraction": model.ag_fraction,
             }
         out_model = ProfileFamily(entries=entries)
         meta["world_sizes"] = extents
@@ -231,6 +253,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             "overlap": out_model.overlap,
             "pack_beta_s_per_byte": out_model.pack_beta,
             "update_beta_s_per_byte": out_model.update_beta,
+            "ag_fraction": out_model.ag_fraction,
             "samples": len(prof.sizes_bytes),
             "out": args.out,
         }

@@ -92,6 +92,12 @@ class TrainConfig:
     # autotune/resize/checkpoint/watchdog events — one schema-versioned
     # JSONL per run, rendered by tools/telemetry_report.py
     telemetry_dir: Optional[str] = None  # events dir; default <logdir>/<tag>
+    metrics_port: Optional[int] = None  # live observability plane
+    # (telemetry/serve.py): per-process HTTP server exposing /metrics
+    # (Prometheus, live), /healthz (watchdog-wired liveness), /status
+    # (run JSON). None = off; 0 = ephemeral port (logged); a multi-host
+    # group serves port + process_index per process. Env:
+    # MGWFBP_METRICS_PORT (the generic MGWFBP_<field> override)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 1
     # resilience layer (ISSUE 5)

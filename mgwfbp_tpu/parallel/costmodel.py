@@ -69,6 +69,16 @@ class AlphaBeta:
     # into this constant; 0.0 (default) prices the update as free — the
     # elementwise optimizer math is usually negligible next to the wire.
     update_beta: float = 0.0
+    # fraction of the full-collective time attributable to the ALL-GATHER
+    # phase of a ring all-reduce (reduce-scatter = 1 - ag_fraction). The
+    # cross-step rs_fwd_ag solver splits each bucket's predicted time
+    # between its backward-side RS leg and its forward-side deferred AG
+    # leg by this fraction (solver.cross_step_phase_costs). Default 0.5:
+    # both phases move (P-1)/P of the payload, so an even split is the
+    # principled prior; `calibrate --allgather` MEASURES it (an AG sweep
+    # against the full-collective sweep), replacing the prior with the
+    # link's real asymmetry (ROADMAP PR-7 follow-up b).
+    ag_fraction: float = 0.5
 
     def predict(self, nbytes) -> float:
         return self.alpha + self.beta * nbytes
@@ -103,6 +113,7 @@ class SampledCost:
     overlap: float = 1.0
     pack_beta: float = 0.0
     update_beta: float = 0.0
+    ag_fraction: float = 0.5  # see AlphaBeta.ag_fraction
 
     def __post_init__(self):
         # predict() is the solver's inner-loop cost function (auto_groups
@@ -190,6 +201,9 @@ def refit_from_observations(
         overlap=float(getattr(model, "overlap", 1.0)),
         pack_beta=float(getattr(model, "pack_beta", 0.0)),
         update_beta=update_beta,
+        # the phase split is fit by a dedicated AG sweep (calibrate
+        # --allgather), not by whole-collective residuals; carry it over
+        ag_fraction=float(getattr(model, "ag_fraction", 0.5)),
     )
 
 
@@ -358,7 +372,7 @@ def interp_alpha_beta(
         return AlphaBeta(
             alpha=base.alpha * scale, beta=base.beta, gamma=base.gamma,
             overlap=base.overlap, pack_beta=base.pack_beta,
-            update_beta=base.update_beta,
+            update_beta=base.update_beta, ag_fraction=base.ag_fraction,
         )
     # intermediate count: log2-interpolate between the bracketing entries
     lo = max(k for k in known if k < nworkers)
@@ -370,9 +384,10 @@ def interp_alpha_beta(
     ov = table[lo].overlap * (1 - t) + table[hi].overlap * t
     pb = table[lo].pack_beta * (1 - t) + table[hi].pack_beta * t
     ub = table[lo].update_beta * (1 - t) + table[hi].update_beta * t
+    af = table[lo].ag_fraction * (1 - t) + table[hi].ag_fraction * t
     return AlphaBeta(
         alpha=float(a), beta=float(b), gamma=float(g), overlap=float(ov),
-        pack_beta=float(pb), update_beta=float(ub),
+        pack_beta=float(pb), update_beta=float(ub), ag_fraction=float(af),
     )
 
 
@@ -400,6 +415,7 @@ class ProfileFamily:
                 dataclasses.replace(
                     v.ab, gamma=v.gamma, overlap=v.overlap,
                     pack_beta=v.pack_beta, update_beta=v.update_beta,
+                    ag_fraction=v.ag_fraction,
                 )
                 if isinstance(v, SampledCost)
                 else v
@@ -580,19 +596,30 @@ class TwoLevelAlphaBeta:
         # the rs_opt_ag shard update runs once, on the inner-level shard
         return self.ici.update_beta
 
+    @property
+    def ag_fraction(self) -> float:
+        # the cross-step deferral moves the ICI-side gather; the DCN hop
+        # completes at backward time either way, so the inner link's
+        # measured split is the one that prices the deferred leg
+        return self.ici.ag_fraction
+
 
 # ---------------------------------------------------------------------------
 # Profile (de)serialization. Every stamped file carries `schema_version`:
 #   1 — the pre-stamp legacy layout (no version field); identical field set,
 #       migrated on load by assuming the v2 field defaults;
-#   2 — current: v1 plus the explicit stamp.
+#   2 — v1 plus the explicit stamp;
+#   3 — current: v2 plus `ag_fraction` (the measured RS/AG phase split a
+#       `calibrate --allgather` sweep fits; v1/v2 files migrate with the
+#       historical even split of 0.5 — exactly what the cross-step solver
+#       assumed before the split was measurable).
 # Unknown versions are REJECTED with a clear error instead of half-parsing:
 # the autotuner's schedule cache reuses this convention (autotune.py) and
 # both formats will evolve.
 # ---------------------------------------------------------------------------
 
-PROFILE_SCHEMA_VERSION = 2
-_SUPPORTED_PROFILE_SCHEMAS = (1, 2)
+PROFILE_SCHEMA_VERSION = 3
+_SUPPORTED_PROFILE_SCHEMAS = (1, 2, 3)
 
 
 def check_schema_version(
@@ -626,6 +653,7 @@ def _model_dict(model: "AlphaBeta | SampledCost") -> dict:
             "overlap": model.overlap,
             "pack_beta": model.pack_beta,
             "update_beta": model.update_beta,
+            "ag_fraction": model.ag_fraction,
         }
     return dataclasses.asdict(model)
 
@@ -640,6 +668,9 @@ def _model_from_dict(d: dict) -> "AlphaBeta | SampledCost":
             overlap=d.get("overlap", 1.0),
             pack_beta=d.get("pack_beta", 0.0),
             update_beta=d.get("update_beta", 0.0),
+            # v1/v2 files predate the measured split: the halved-predictor
+            # default keeps their cross-step schedules bit-identical
+            ag_fraction=d.get("ag_fraction", 0.5),
         )
     d = {k: v for k, v in d.items() if k != "kind"}
     return AlphaBeta(**d)

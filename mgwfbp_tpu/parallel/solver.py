@@ -67,11 +67,15 @@ def effective_cost_fn(cost_model, comm_op: str = "all_reduce") -> CostFn:
 
 
 # A ring all-reduce is reduce-scatter + all-gather, each moving (P-1)/P of
-# the payload: the calibrated full-collective predictor splits evenly
-# between the two phases for the cross-step timeline. (Calibrations here
-# measure the full all-reduce; a dedicated per-phase calibration would
-# refine the split, not the sum.)
+# the payload: absent a measurement, the calibrated full-collective
+# predictor splits evenly between the two phases for the cross-step
+# timeline. This is only the DEFAULT prior — a `calibrate --allgather`
+# sweep measures the link's real split and persists it as the profile's
+# `ag_fraction` (costmodel, schema v3), which `cross_step_phase_costs`
+# prefers; the split is clamped to [MIN_AG_FRACTION, 1-MIN_AG_FRACTION]
+# so a degenerate calibration can never zero out a whole phase.
 CROSS_STEP_RS_FRACTION = 0.5
+MIN_AG_FRACTION = 0.05
 
 
 def cross_step_phase_costs(cost_model) -> tuple[CostFn, CostFn]:
@@ -83,15 +87,25 @@ def cross_step_phase_costs(cost_model) -> tuple[CostFn, CostFn]:
     leg rides the NEXT step's forward-side timeline. The two sum to
     `effective_cost_fn(cost_model, 'rs_fwd_ag')` by construction, so
     per-group totals (predict_group_times, overlap accounting) and the
-    two-phase simulate can never disagree on a bucket's wire time."""
+    two-phase simulate can never disagree on a bucket's wire time.
+
+    The RS/AG split comes from the cost model's measured ``ag_fraction``
+    when a `calibrate --allgather` sweep fit one; models without it (v1/v2
+    profiles, built-in tables) keep the historical halved split
+    (`CROSS_STEP_RS_FRACTION`)."""
     base = cost_model.predict
     ub = float(getattr(cost_model, "update_beta", 0.0))
+    ag_frac = float(getattr(
+        cost_model, "ag_fraction", 1.0 - CROSS_STEP_RS_FRACTION
+    ))
+    ag_frac = min(max(ag_frac, MIN_AG_FRACTION), 1.0 - MIN_AG_FRACTION)
+    rs_frac = 1.0 - ag_frac
 
     def rs_cost(nbytes: float) -> float:
-        return CROSS_STEP_RS_FRACTION * base(nbytes) + ub * nbytes
+        return rs_frac * base(nbytes) + ub * nbytes
 
     def ag_cost(nbytes: float) -> float:
-        return (1.0 - CROSS_STEP_RS_FRACTION) * base(nbytes)
+        return ag_frac * base(nbytes)
 
     return rs_cost, ag_cost
 

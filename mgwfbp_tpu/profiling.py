@@ -96,6 +96,84 @@ def profile_allreduce(
     )
 
 
+def profile_allgather(
+    mesh: Mesh,
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    warmup: int = 5,
+    iters: int = 20,
+    axis_name: str = DATA_AXIS,
+    dtype=jnp.float32,
+) -> CommProfile:
+    """Time one tiled all-gather per payload size on the real mesh.
+
+    ``sizes`` are FULL-payload element counts (the same axis as
+    `profile_allreduce`): each member holds n/P elements and the gather
+    reassembles n — exactly the AG leg of an n-element ring all-reduce,
+    and exactly what the cross-step rs_fwd_ag lowering defers into the
+    next step's forward. The ratio of this sweep to the full-collective
+    sweep fits `ag_fraction` (`fit_ag_fraction`), replacing the solver's
+    halved-split prior with the link's measured RS/AG asymmetry
+    (ROADMAP PR-7 follow-up b)."""
+    times, nbytes = [], []
+    itemsize = jnp.dtype(dtype).itemsize
+    world = int(mesh.shape[axis_name])
+    for n in sizes:
+        shard = max(n // world, 1)
+
+        def f(x):
+            return lax.all_gather(x, axis_name, tiled=True)
+
+        fn = jax.jit(
+            shard_map(
+                f, mesh=mesh, in_specs=P(axis_name), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        x = jnp.ones((shard * world,), dtype)
+        for _ in range(warmup):
+            fn(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn(x).block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        times.append(dt)
+        nbytes.append(shard * world * itemsize)
+    return CommProfile(
+        sizes_bytes=nbytes, times_s=times, model=fit_alpha_beta(nbytes, times)
+    )
+
+
+def fit_ag_fraction(
+    full: CommProfile, ag: CommProfile,
+    lo: float = 0.05, hi: float = 0.95,
+) -> float:
+    """ag_fraction from paired sweeps: the median per-size ratio of the
+    all-gather time to the full-collective time, clamped to [lo, hi] —
+    a degenerate calibration (noise making AG "free" or "everything")
+    must not zero out a whole phase of the cross-step timeline. The
+    sweeps come from the same `calibrate` invocation over the same size
+    list, so samples pair by INDEX (the recorded payload bytes differ
+    when world does not divide a sweep size — the AG sweep rounds to
+    whole shards). Mismatched sweeps fall back to the 0.5 prior with a
+    warning: a silently unmeasured split stamped as measured is exactly
+    what this function must not produce."""
+    import logging
+
+    ratios = [
+        ag_t / full_t
+        for full_t, ag_t in zip(full.times_s, ag.times_s)
+        if full_t > 0.0
+    ]
+    if len(full.times_s) != len(ag.times_s) or not ratios:
+        logging.getLogger("mgwfbp.profiling").warning(
+            "fit_ag_fraction: sweeps do not pair (%d full vs %d ag "
+            "samples); keeping the unmeasured 0.5 phase-split prior",
+            len(full.times_s), len(ag.times_s),
+        )
+        return 0.5
+    return float(min(max(float(np.median(ratios)), lo), hi))
+
+
 def profile_group_overhead(
     mesh: Mesh,
     alpha: float,

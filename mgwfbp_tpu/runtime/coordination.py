@@ -153,6 +153,19 @@ def broadcast_flag(value: float, source: int = 0) -> float:
     return float(_device_reduce([contrib], "sum")[0])
 
 
+def gather_values(value: float) -> list[float]:
+    """Every process's scalar, in process order, identical everywhere
+    (the live straggler probe: each process contributes its window step
+    time; everyone sees the full per-process vector and agrees on who is
+    slow). One-hot rows summed — same transport, same lockstep contract
+    as every other primitive here."""
+    if process_count() == 1:
+        return [float(value)]
+    row = [0.0] * process_count()
+    row[process_index()] = float(value)
+    return [float(t) for t in _device_reduce(row, "sum")]
+
+
 def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
     """Agreed argmin over per-candidate timings.
 

@@ -26,6 +26,14 @@ overrides) is inherited, so `MGWFBP_FAULT_PLAN='preempt@step=4,proc=1'`
 preempts exactly one process of the group and exercises the agreed
 drain end to end.
 
+Live observability plane (ISSUE 9): with MGWFBP_METRICS_PORT set, each
+child serves /metrics /healthz /status on port + process_index
+(telemetry/serve.py); the supervisor logs each child's port at launch,
+and an rc-86 stop (a wedged grant the watchdog aborted) includes every
+still-reachable child's last /status snapshot in the stop message — the
+dead group's final state lands in the supervisor log next to the stack
+dumps it points at.
+
 `python -m mgwfbp_tpu.runtime.supervise --processes 2 -- <train args>`
 is the CLI (see runtime/supervise.py).
 """
@@ -120,8 +128,44 @@ class Supervisor:
         self.sleep = sleep
         self.log = get_logger("mgwfbp.supervisor")
         self.results: list[GroupResult] = []
+        # last /status of each still-alive peer, captured by _watch at
+        # the moment an rc-86 exit is first observed (None = no abort
+        # seen yet this incarnation)
+        self._status_snapshots: Optional[dict] = None
 
     # -- launch ------------------------------------------------------------
+    def _metrics_base_port(self) -> Optional[int]:
+        """The group's configured metrics base port (child i serves
+        base + i — telemetry/serve.resolve_metrics_port), or None when
+        the plane is off or the base is ephemeral (0: per-child ports are
+        unknowable from outside)."""
+        raw = (self.env.get("MGWFBP_METRICS_PORT") or "").strip()
+        if not raw:
+            return None
+        try:
+            base = int(raw)
+        except ValueError:
+            return None
+        return base if base > 0 else None
+
+    def _child_status(self, idx: int, timeout_s: float = 2.0):
+        """Last /status snapshot of child `idx`, or None when the plane
+        is off / the child is gone."""
+        base = self._metrics_base_port()
+        if base is None:
+            return None
+        import json as _json
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{base + idx}/status", timeout=timeout_s
+            ) as resp:
+                return _json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — a dead child's port refusing
+            # is the expected case; the snapshot is best-effort
+            return None
+
     def _child_env(self, idx: int, port: int) -> dict:
         env = dict(self.env)
         env["MGWFBP_COORDINATOR"] = f"127.0.0.1:{port}"
@@ -146,11 +190,20 @@ class Supervisor:
         ), stdout
 
     def _run_group(self, incarnation: int) -> GroupResult:
+        self._status_snapshots = None  # fresh capture per incarnation
         port = self.port if self.port is not None else free_port()
         self.log.info(
             "incarnation %d: launching %d process(es) (coordinator "
             "127.0.0.1:%d)", incarnation, self.processes, port,
         )
+        metrics_base = self._metrics_base_port()
+        if metrics_base is not None:
+            for i in range(self.processes):
+                self.log.info(
+                    "incarnation %d: process %d metrics at "
+                    "http://127.0.0.1:%d (/metrics /healthz /status)",
+                    incarnation, i, metrics_base + i,
+                )
         procs, logs = [], []
         for i in range(self.processes):
             p, f = self._spawn(i, incarnation, port)
@@ -184,6 +237,16 @@ class Supervisor:
             if not pending:
                 return [int(p.returncode) for p in procs]
             done = [p.returncode for p in procs if p.returncode is not None]
+            if WATCHDOG_RC in done and self._status_snapshots is None:
+                # capture NOW, while the aborting process's peers are
+                # still alive and serving /status — by the time run()
+                # applies the rc policy every child has been torn down
+                # and the ports refuse
+                self._status_snapshots = {
+                    i: s for i, p in enumerate(procs)
+                    if p.poll() is None
+                    and (s := self._child_status(i)) is not None
+                }
             if done and deadline is None:
                 # rc 0/75: peers are finishing up or drain-agreeing and
                 # checkpointing — give them the drain window. Anything
@@ -248,11 +311,25 @@ class Supervisor:
                     f" (per-process logs under {self.log_dir})"
                     if self.log_dir else " (see the group's stderr)"
                 )
+                # the dead group's final state: _watch captured every
+                # still-alive peer's /status at the moment the rc-86
+                # exit was observed (the group is fully torn down by
+                # now), so the post-mortem starts from the supervisor
+                # log, not from N scattered ports that no longer answer
+                snapshots = self._status_snapshots or {}
+                detail = ""
+                if snapshots:
+                    import json as _json
+
+                    detail = " Last /status snapshot(s): " + "; ".join(
+                        f"p{i}: {_json.dumps(s)}"
+                        for i, s in sorted(snapshots.items())
+                    )
                 self.log.error(
                     "watchdog abort (rc %d): a process dumped all thread "
                     "stacks before exiting%s. A wedged device grant does "
-                    "not heal on restart — NOT resubmitting.",
-                    WATCHDOG_RC, where,
+                    "not heal on restart — NOT resubmitting.%s",
+                    WATCHDOG_RC, where, detail,
                 )
                 return WATCHDOG_RC
             if not result.preempted:

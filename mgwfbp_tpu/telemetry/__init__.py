@@ -1,13 +1,24 @@
 """Run-observability subsystem: typed event stream, overlap-efficiency
-accounting, Chrome-trace / Prometheus export.
+accounting, Chrome-trace / Prometheus export, and the LIVE plane.
 
 Every layer feeds one append-only, schema-versioned JSONL stream per run
 (`telemetry/events.py`); `telemetry/overlap.py` turns per-group comm times
 (trace-attributed or cost-model-predicted) into the paper's exposed-vs-
 hidden accounting; `telemetry/export.py` renders the stream for Perfetto
-and Prometheus; `tools/telemetry_report.py` prints the human summary.
+and Prometheus (one metric registry shared with the live endpoint);
+`telemetry/serve.py` serves /metrics, /healthz and /status per process
+from an in-memory aggregator fed by the same stream;
+`telemetry/drift.py` watches predicted-vs-measured cost-model residuals
+and the multi-host straggler signal; `tools/telemetry_report.py` prints
+the human summary.
 """
 
+from mgwfbp_tpu.telemetry.drift import (
+    DriftAlarm,
+    DriftConfig,
+    DriftDetector,
+    StragglerDetector,
+)
 from mgwfbp_tpu.telemetry.events import (
     EVENT_SCHEMA_VERSION,
     EVENT_TYPES,
@@ -25,8 +36,20 @@ from mgwfbp_tpu.telemetry.overlap import (
     group_comm_times,
     summarize,
 )
+from mgwfbp_tpu.telemetry.serve import (
+    MetricsAggregator,
+    TelemetryServer,
+    start_metrics_server,
+)
 
 __all__ = [
+    "DriftAlarm",
+    "DriftConfig",
+    "DriftDetector",
+    "StragglerDetector",
+    "MetricsAggregator",
+    "TelemetryServer",
+    "start_metrics_server",
     "EVENT_SCHEMA_VERSION",
     "EVENT_TYPES",
     "EventWriter",

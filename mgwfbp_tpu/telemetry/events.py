@@ -92,6 +92,21 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # a restart picked up from a saved snapshot (mid_epoch = step-indexed
     # mid-epoch checkpoint, i.e. the preemption-safe resume path)
     "resume": ("epoch", "iteration", "mid_epoch"),
+    # --- live observability plane (ISSUE 9) ----------------------------
+    # cost-model drift (telemetry/drift.py): `kind` is 'comm_residual'
+    # (predicted-vs-measured merge-group comm, `group` = arrival index or
+    # -1 for the aggregate) or 'step_trend' (EWMA step time vs the
+    # baseline window); `residual` is the ratio/excess that crossed (or
+    # re-entered) `band`; active=True raises the alarm, False clears it
+    # (hysteresis guarantees no flapping between the two)
+    "drift_alarm": ("kind", "step", "residual", "band", "active"),
+    # live multi-host straggler probe: per agree-interval the group
+    # gathers its window step times (runtime/coordination); the slowest
+    # process is named in `slow_process` (NOT 'process' — the merge tool
+    # stamps each record with its emitting stream's process index under
+    # that key). excess_s = slowest minus fastest window step seconds.
+    "straggler": ("step", "slow_process", "excess_s", "step_s_max",
+                  "step_s_min", "active"),
 }
 
 _JSON_SCALARS = (str, int, float, bool, type(None))
@@ -214,7 +229,15 @@ class EventWriter:
         path: str,
         run: Optional[dict] = None,
         max_bytes: Optional[int] = None,
+        observer=None,
     ):
+        # observer(event, fields) is called for every emitted record AFTER
+        # schema validation — the live metrics aggregator
+        # (telemetry/serve.py) tees off here so the /metrics endpoint and
+        # the JSONL file are fed by the SAME validated stream. A failing
+        # observer is detached, never fatal: observability must not kill
+        # the run it observes.
+        self.observer = observer
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if max_bytes is None:
@@ -279,6 +302,22 @@ class EventWriter:
             )
         for k, v in fields.items():
             _check_jsonable(v, k)
+        if self.observer is not None:
+            try:
+                self.observer(event, fields)
+            except Exception:  # noqa: BLE001 — a broken aggregator must
+                # not take the stream (or the run) down with it; but say
+                # so loudly: from here on the live /metrics//status
+                # surfaces freeze at their last values while the JSONL
+                # keeps advancing
+                import logging
+
+                logging.getLogger("mgwfbp.telemetry").exception(
+                    "telemetry observer failed on %r; detaching — live "
+                    "metrics/health endpoints will no longer update",
+                    event,
+                )
+                self.observer = None
         self._emit_record(event, wall=time.time(), **fields)
 
     def _emit_record(self, event: str, wall: float, **fields) -> None:

@@ -191,49 +191,118 @@ def write_chrome_trace(path: str, records: list[dict]) -> dict:
     return doc
 
 
-def prometheus_text(records: list[dict]) -> str:
-    """Prometheus text-exposition dump of the stream's counters/gauges."""
-    steps = events_of(records, "step")
-    overlaps = events_of(records, "overlap")
-    snap = overlaps[-1] if overlaps else None
+# ---------------------------------------------------------------------------
+# Metric registry: THE single statement of every Prometheus metric this
+# framework exposes — names, kinds, help text. Both renderers read it:
+# the post-hoc file dump (`prometheus_text`, below) and the live /metrics
+# endpoint (`telemetry.serve.TelemetryServer`) render the SAME registry
+# from the SAME aggregator (`serve.MetricsAggregator`), so the two
+# surfaces cannot drift apart (ISSUE 9 satellite: the names/labels used
+# to be built ad hoc inside prometheus_text).
+# ---------------------------------------------------------------------------
 
+# (name, kind, help). Order is the exposition order; values absent from
+# the aggregator (e.g. no overlap snapshot yet) are simply not rendered.
+METRICS: tuple[tuple[str, str, str], ...] = (
+    ("mgwfbp_steps_total", "counter",
+     "optimizer steps recorded in the telemetry stream"),
+    ("mgwfbp_step_seconds", "gauge",
+     "mean seconds per step over the last spans"),
+    ("mgwfbp_current_step", "gauge",
+     "latest optimizer step (host iteration counter)"),
+    ("mgwfbp_current_epoch", "gauge", "latest epoch seen in the stream"),
+    ("mgwfbp_overlap_efficiency", "gauge",
+     "hidden / total communication time (latest snapshot)"),
+    ("mgwfbp_comm_hidden_seconds", "gauge",
+     "per-step communication hidden behind backward (latest)"),
+    ("mgwfbp_comm_exposed_seconds", "gauge",
+     "per-step communication on the critical path (latest)"),
+    ("mgwfbp_resizes_total", "counter", "elastic worker-count resizes"),
+    ("mgwfbp_checkpoints_total", "counter", "checkpoint saves"),
+    ("mgwfbp_last_checkpoint_iteration", "gauge",
+     "iteration of the most recent checkpoint save"),
+    ("mgwfbp_watchdog_stalls_total", "counter",
+     "watchdog stall detections"),
+    ("mgwfbp_autotune_races_total", "counter",
+     "autotune candidates raced"),
+    ("mgwfbp_autotune_commits_total", "counter",
+     "autotune schedule commits (race or cache)"),
+    ("mgwfbp_bench_skips_total", "counter",
+     "bench runs skipped (chip unavailable)"),
+    ("mgwfbp_bad_steps_total", "counter",
+     "steps dropped by the non-finite-gradient guard"),
+    ("mgwfbp_rollbacks_total", "counter",
+     "bad-step rollbacks to the last checkpoint"),
+    ("mgwfbp_preempts_total", "counter", "graceful preemption drains"),
+    ("mgwfbp_resumes_total", "counter", "restarts from a saved snapshot"),
+    ("mgwfbp_drift_alarms_total", "counter",
+     "cost-model drift alarms raised (telemetry.drift)"),
+    ("mgwfbp_drift_residual", "gauge",
+     "latest drift residual (predicted/measured comm ratio, or "
+     "step-trend excess fraction)"),
+    ("mgwfbp_straggler_alarms_total", "counter",
+     "live straggler alarms raised (multi-host probe)"),
+    ("mgwfbp_straggler_excess_seconds", "gauge",
+     "latest straggler probe: slowest minus fastest process window "
+     "step seconds"),
+    ("mgwfbp_active_alarms", "gauge",
+     "currently-active drift/straggler alarms"),
+)
+
+# event type -> counter metric (shared by the aggregator's incremental
+# counting and anyone asking which events are counted at all)
+EVENT_COUNTERS: dict[str, str] = {
+    "step": "mgwfbp_steps_total",
+    "resize": "mgwfbp_resizes_total",
+    "checkpoint": "mgwfbp_checkpoints_total",
+    "watchdog_stall": "mgwfbp_watchdog_stalls_total",
+    "autotune_race": "mgwfbp_autotune_races_total",
+    "autotune_commit": "mgwfbp_autotune_commits_total",
+    "bench_skip": "mgwfbp_bench_skips_total",
+    "bad_step": "mgwfbp_bad_steps_total",
+    "rollback": "mgwfbp_rollbacks_total",
+    "preempt": "mgwfbp_preempts_total",
+    "resume": "mgwfbp_resumes_total",
+}
+
+
+def render_metrics(values: dict) -> str:
+    """Prometheus text exposition of a metric-value dict, in registry
+    order. `values` maps registry names to numbers (int -> rendered as an
+    integer, float -> %g); names missing from the dict are skipped, names
+    outside the registry are rejected — an unregistered metric is exactly
+    the file-dump-vs-live-endpoint drift this registry exists to stop."""
+    known = {name for name, _, _ in METRICS}
+    stray = set(values) - known
+    if stray:
+        raise ValueError(
+            f"metrics {sorted(stray)} are not in telemetry.export.METRICS; "
+            "register them there so every exposition surface shows them"
+        )
     lines: list[str] = []
-
-    def metric(name: str, kind: str, help_: str, value) -> None:
+    for name, kind, help_ in METRICS:
+        if name not in values:
+            continue
+        v = values[name]
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {kind}")
-        lines.append(f"{name} {value:g}" if isinstance(value, float)
-                     else f"{name} {value}")
-
-    metric("mgwfbp_steps_total", "counter",
-           "optimizer steps recorded in the telemetry stream", len(steps))
-    if steps:
-        recent = steps[-min(len(steps), 20):]
-        mean = sum(float(s["dur_s"]) for s in recent) / len(recent)
-        metric("mgwfbp_step_seconds", "gauge",
-               "mean seconds per step over the last spans", float(mean))
-    if snap is not None:
-        metric("mgwfbp_overlap_efficiency", "gauge",
-               "hidden / total communication time (latest snapshot)",
-               float(snap.get("efficiency", 0.0)))
-        metric("mgwfbp_comm_hidden_seconds", "gauge",
-               "per-step communication hidden behind backward (latest)",
-               float(snap.get("hidden_s", 0.0)))
-        metric("mgwfbp_comm_exposed_seconds", "gauge",
-               "per-step communication on the critical path (latest)",
-               float(snap.get("exposed_s", 0.0)))
-    for name, ev, help_ in (
-        ("mgwfbp_resizes_total", "resize", "elastic worker-count resizes"),
-        ("mgwfbp_checkpoints_total", "checkpoint", "checkpoint saves"),
-        ("mgwfbp_watchdog_stalls_total", "watchdog_stall",
-         "watchdog stall detections"),
-        ("mgwfbp_autotune_races_total", "autotune_race",
-         "autotune candidates raced"),
-        ("mgwfbp_bench_skips_total", "bench_skip",
-         "bench runs skipped (chip unavailable)"),
-    ):
-        metric(name, "counter", help_, len(events_of(records, ev)))
+        lines.append(f"{name} {v:g}" if isinstance(v, float)
+                     else f"{name} {v}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text(records: list[dict]) -> str:
+    """Prometheus text-exposition dump of the stream's counters/gauges.
+
+    Implemented by replaying the records through the SAME aggregator the
+    live /metrics endpoint serves from (`serve.MetricsAggregator`), so
+    the file dump and the endpoint render identical values through one
+    registry by construction."""
+    from mgwfbp_tpu.telemetry.serve import MetricsAggregator
+
+    agg = MetricsAggregator()
+    agg.replay(records)
+    return render_metrics(agg.values())
 
 
 def write_prometheus(path: str, records: list[dict]) -> str:

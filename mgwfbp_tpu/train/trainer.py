@@ -264,6 +264,43 @@ class Trainer:
         self._faults = FaultPlan.from_env().for_process(jax.process_index())
         if self._faults:
             self.log.info("fault plan armed: %s", self._faults.describe())
+        # live observability plane (ISSUE 9): online cost-model drift
+        # detection + multi-host straggler probe (telemetry/drift.py).
+        # Pure host arithmetic at the logging cadence — the step loop
+        # gains zero device syncs from any of it. The straggler probe and
+        # the drift-reautotune agreement are COLLECTIVES, so their gates
+        # read only group-uniform state (env-derived config, the lockstep
+        # iteration counter).
+        from mgwfbp_tpu.telemetry.drift import (
+            DriftConfig,
+            DriftDetector,
+            StragglerDetector,
+            reautotune_enabled,
+        )
+
+        self._drift_cfg = DriftConfig.from_env()
+        self._drift_detector = (
+            DriftDetector(self._drift_cfg) if config.telemetry else None
+        )
+        self._straggler_detector = StragglerDetector(
+            self._drift_cfg.straggler_band, self._drift_cfg.hysteresis,
+            self._drift_cfg.straggler_min_excess_s,
+        )
+        self._straggler_enabled = (
+            config.telemetry and self._drift_cfg.straggler_band > 0
+        )
+        self._drift_reautotune_enabled = reautotune_enabled()
+        self._drift_reautotune_pending = False
+        # straggler probe bookkeeping: synchronous SGD equalizes
+        # END-TO-END step walls across the group (everyone waits for the
+        # straggler inside the collectives — on the CPU mesh even the
+        # dispatch call blocks there), so the probe gathers each
+        # process's LOCAL busy seconds per step — loader/batch prep and
+        # injected stalls, ending BEFORE the dispatch — the share that
+        # actually differs on a slow host
+        self._local_busy_s = 0.0
+        self._probe_iter = 0  # last probed iteration
+        self._probe_busy = 0.0  # _local_busy_s at the last probe
         self._preempt_signal: Optional[str] = None
         # multi-host: how often (in optimizer steps) the group runs the
         # tiny agree_any collective that turns ONE host's preemption
@@ -505,11 +542,25 @@ class Trainer:
         # tools/telemetry_merge.py reassembles a multi-host group's
         # streams into one global timeline + straggler table
         self.telemetry = None
+        if config.metrics_port is not None and not config.telemetry:
+            # the live plane's aggregator is fed by the event stream —
+            # a metrics port implies the stream, exactly like the CLI
+            config.telemetry = True
         tel_dir = config.telemetry_dir or (
             os.path.join(config.logdir, config.tag())
             if config.logdir
             else None
         )
+        run_meta = {
+            "model": config.dnn,
+            "dataset": config.dataset,
+            "world": self.data_size * self.seq_size,
+            "comm_op": config.comm_op,
+            "policy": config.policy,
+            "tag": config.tag(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
         if config.telemetry:
             if tel_dir is None:
                 self.log.warning(
@@ -523,17 +574,30 @@ class Trainer:
                     os.path.join(tel_dir, stream_filename(
                         jax.process_index(), jax.process_count()
                     )),
-                    run={
-                        "model": config.dnn,
-                        "dataset": config.dataset,
-                        "world": self.data_size * self.seq_size,
-                        "comm_op": config.comm_op,
-                        "policy": config.policy,
-                        "tag": config.tag(),
-                        "process_index": jax.process_index(),
-                        "process_count": jax.process_count(),
-                    },
+                    run=run_meta,
                 )
+        # live observability plane (ISSUE 9): one in-memory aggregator +
+        # HTTP server per process, created once and kept across resize
+        # rebinds (the port must not churn mid-run); the NEW writer is
+        # tee'd into the same aggregator. The server thread reads host
+        # state only — the zero-sync contract holds with it enabled.
+        if (
+            config.metrics_port is not None
+            and getattr(self, "_metrics_agg", None) is None
+        ):
+            from mgwfbp_tpu.telemetry.serve import (
+                MetricsAggregator,
+                start_metrics_server,
+            )
+
+            self._metrics_agg = MetricsAggregator(run=run_meta)
+            self._metrics_server = start_metrics_server(
+                self._metrics_agg, config.metrics_port, jax.process_index()
+            )
+        agg = getattr(self, "_metrics_agg", None)
+        if agg is not None and self.telemetry is not None:
+            self.telemetry.observer = agg.observe
+        self._sync_schedule_gauge()
         # scalar event stream (reference's tensorboardX seam, live):
         # process 0 only, like the reference's rank-gated writer. With
         # telemetry on, the ScalarWriter is a thin view over the SAME
@@ -684,10 +748,173 @@ class Trainer:
         self, phase: str, idle_s: float, timeout_s: float, abort: bool
     ) -> None:
         """Watchdog stall/abort -> structured event in the run's stream
-        (post-mortems of a wedged device grep ONE file, not stderr)."""
+        (post-mortems of a wedged device grep ONE file, not stderr). The
+        event also flips /healthz unhealthy through the aggregator tee —
+        BEFORE an rc-86 abort kills the process, so a prober sees 503,
+        not a reset connection."""
         self._emit_event(
             "watchdog_stall", phase=str(phase), idle_s=float(idle_s),
             timeout_s=float(timeout_s), abort=bool(abort),
+        )
+
+    def _sync_schedule_gauge(self) -> None:
+        """Push the committed schedule into the /status aggregator (at
+        build, autotune commit / hot swap, and elastic resize)."""
+        agg = getattr(self, "_metrics_agg", None)
+        if agg is None:
+            return
+        reducer = getattr(self, "reducer", None)
+        if reducer is None:
+            agg.set_schedule("none", 0, self.config.policy)
+        else:
+            agg.set_schedule(
+                reducer.comm_op,
+                reducer.layout.num_groups,
+                reducer.schedule.policy_detail or self.config.policy,
+                float(reducer.schedule.predicted_nonoverlap_time),
+            )
+
+    def _observe_drift_window(self, step_s: float) -> None:
+        """Feed one measured log-window step time to the drift detector
+        and emit any alarm edges (telemetry/drift.py). Host arithmetic
+        only. A raised alarm arms the re-autotune trigger when
+        MGWFBP_DRIFT_REAUTOTUNE=1 (fired at a deterministic step
+        boundary; multi-host rides agree_any so the race is lockstep)."""
+        det = self._drift_detector
+        if det is None or step_s <= 0.0:
+            return
+        if not getattr(self, "_drift_window_seen", False):
+            # the run's FIRST log window amortizes the one-off XLA
+            # compile; feeding it would poison every baseline the
+            # detector learns
+            self._drift_window_seen = True
+            return
+        alarms = list(det.observe_step_window(step_s))
+        cost_model = getattr(self, "cost_model", None)
+        if self.reducer is not None and cost_model is not None:
+            from mgwfbp_tpu.telemetry import group_comm_times
+
+            predicted, _, _ = group_comm_times(self.reducer, cost_model)
+            measured = self._measured_group_times
+            if measured is not None and len(measured) == len(predicted):
+                alarms += det.observe_comm(predicted, measured_s=measured)
+            elif self._tb_cache is not None:
+                # aggregate upper bound: the non-backward share of the
+                # measured step (the autotune step-delta attribution) —
+                # needs a MEASURED tb (the size-prior tb is itself a comm
+                # prediction and would corrupt the residual)
+                measured_total = step_s - float(sum(self._tb_cache))
+                if measured_total > 0.0:
+                    alarms += det.observe_comm(
+                        predicted, measured_total_s=measured_total
+                    )
+        for a in alarms:
+            self.log.warning(
+                "drift %s: %s alarm (residual %.3g vs band %.3g%s)",
+                "RAISED" if a.active else "cleared", a.kind, a.residual,
+                a.band, f", group {a.group}" if a.group >= 0 else "",
+            )
+            self._emit_event(
+                "drift_alarm", kind=a.kind, step=int(self.iteration),
+                residual=float(a.residual), band=float(a.band),
+                active=bool(a.active), group=int(a.group),
+            )
+            if a.active and self._drift_reautotune_enabled:
+                self._drift_reautotune_pending = True
+
+    def _maybe_drift_reautotune(self) -> None:
+        """Fire the armed drift re-autotune at a deterministic step
+        boundary. Multi-host: EVERY process runs the agree_any at every
+        agree-interval step (the gate reads only group-uniform state), so
+        one process's local alarm pulls the whole group into the same
+        lockstep candidate race the startup autotune runs."""
+        if not self._drift_reautotune_enabled:
+            return
+        if coord.process_count() == 1:
+            if self._drift_reautotune_pending:
+                self._drift_reautotune()
+            return
+        if self.iteration % self._agree_interval != 0:
+            return
+        if coord.agree_any(self._drift_reautotune_pending):
+            self._drift_reautotune()
+
+    def _drift_reautotune(self) -> None:
+        """Re-race the schedule frontier on the live job through the
+        existing hot-swap seam (`autotune(force=True)` ->
+        `_swap_reducer`): the race re-measures, the refit corrects the
+        cost model, and the measured argmin replaces the drifted
+        schedule. The detector resets afterwards — its residuals
+        described the OLD model."""
+        self._drift_reautotune_pending = False
+        if self.reducer is None:
+            return
+        self.log.warning(
+            "cost-model drift: re-autotuning the merge schedule on the "
+            "live job (MGWFBP_DRIFT_REAUTOTUNE=1)"
+        )
+        self.autotune(force=True)
+        self._reset_drift_baselines()
+
+    def _reset_drift_baselines(self) -> None:
+        """Resolve any raised drift alarms and forget the detector's
+        baselines — called whenever the regime they described changes out
+        from under them (a drift re-autotune installed a corrected
+        model, a hot schedule swap, an elastic resize changed the world
+        size). Also skips the NEXT log window: it amortizes the swap's
+        recompile and would poison the fresh baselines exactly like the
+        run's first compile window."""
+        det = self._drift_detector
+        if det is None:
+            return
+        for a in det.clear_alarms():
+            self._emit_event(
+                "drift_alarm", kind=a.kind, step=int(self.iteration),
+                residual=float(a.residual), band=float(a.band),
+                active=False, group=int(a.group),
+            )
+        det.reset()
+        self._drift_window_seen = False
+
+    def _maybe_straggler_probe(self) -> None:
+        """Live multi-host straggler probe: at every agree-interval step
+        the group gathers its per-process LOCAL busy seconds per step
+        (coordination.gather_values — one tiny lockstep collective, the
+        same cost class as the preempt agree_any at the same cadence) and
+        the hysteresis detector names a process consistently slower than
+        the fastest by more than MGWFBP_STRAGGLER_BAND. Local busy time
+        (not the end-to-end step wall, which the group's collectives
+        equalize) is what a slow host actually inflates. Every process
+        emits the identical agreed row into its own stream;
+        tools/telemetry_merge.py shows them alongside its post-hoc
+        table."""
+        if not self._straggler_enabled or coord.process_count() == 1:
+            return
+        if self.iteration % self._agree_interval != 0:
+            return
+        steps = self.iteration - self._probe_iter
+        if steps <= 0:
+            return
+        local = (self._local_busy_s - self._probe_busy) / steps
+        self._probe_iter = self.iteration
+        self._probe_busy = self._local_busy_s
+        times = coord.gather_values(local)
+        alarm = self._straggler_detector.observe(times)
+        if alarm is None:
+            return
+        self.log.warning(
+            "straggler %s: process %d is %.4g s/step slower than the "
+            "fastest (%.4g vs %.4g)",
+            "RAISED" if alarm.active else "cleared", alarm.slow_process,
+            alarm.excess_s, alarm.step_s_max, alarm.step_s_min,
+        )
+        self._emit_event(
+            "straggler", step=int(self.iteration),
+            slow_process=int(alarm.slow_process),
+            excess_s=float(alarm.excess_s),
+            step_s_max=float(alarm.step_s_max),
+            step_s_min=float(alarm.step_s_min),
+            active=bool(alarm.active),
         )
 
     def _cached_schedule_entry(self):
@@ -851,6 +1078,9 @@ class Trainer:
             ),
         )
         self.carry = None  # old carry is sized for the old process batch
+        # step times and comm predictions both changed with the world
+        # size; stale drift baselines would raise alarms that never clear
+        self._reset_drift_baselines()
         self.log.info(
             "update_nworker: resized data axis %d -> %d (process batch %d%s)",
             old, nworkers, self.process_batch,
@@ -866,7 +1096,11 @@ class Trainer:
     # data stream, and the hot-swap through the elastic-resize seam.
     # ------------------------------------------------------------------
 
-    def autotune(self, steps_per_candidate: Optional[int] = None):
+    def autotune(
+        self,
+        steps_per_candidate: Optional[int] = None,
+        force: bool = False,
+    ):
         """Close the solver's loop on the live job.
 
         Races verified candidate schedules for warmup + k REAL training
@@ -879,6 +1113,12 @@ class Trainer:
 
         Returns the report dict (also kept as self.autotune_report), or
         None when there is nothing to tune (no merged reducer).
+
+        ``force=True`` re-races even when a committed cache entry matches
+        (the drift re-autotune path: the entry describes a model the
+        detector just called stale); the new winner overwrites it. The
+        flag must be group-uniform on multi-host — the drift trigger
+        rides agree_any before calling, so it is.
         """
         import itertools
 
@@ -919,7 +1159,9 @@ class Trainer:
         entry = at.load_cache_entry(path)
         names_now = list(self.reducer.schedule.layer_names)
         cache_hit = (
-            entry is not None and entry.get("layer_names") == names_now
+            not force
+            and entry is not None
+            and entry.get("layer_names") == names_now
         )
         if coord.process_count() > 1:
             # the cache is filesystem state: without a shared FS one host
@@ -958,10 +1200,16 @@ class Trainer:
             }
             return self.autotune_report
         if entry is not None:
-            self.log.warning(
-                "autotune: cache entry %s was tuned for a different "
-                "parameter set; re-tuning", path,
-            )
+            if force:
+                self.log.info(
+                    "autotune: forced re-race — committed entry %s will "
+                    "be overwritten by the new winner", path,
+                )
+            else:
+                self.log.warning(
+                    "autotune: cache entry %s was tuned for a different "
+                    "parameter set; re-tuning", path,
+                )
 
         # ---- frontier ------------------------------------------------
         specs = self._layer_specs()
@@ -1268,6 +1516,9 @@ class Trainer:
             self.state = self._from_checkpoint_state(self.state)
             self._build_steps()
             raise
+        self._sync_schedule_gauge()
+        # the detector's baselines described the OLD schedule's regime
+        self._reset_drift_baselines()
 
     def _apply_train_step(self, state, batch):
         """One live train step (autotune race path), carry-aware."""
@@ -1947,6 +2198,15 @@ class Trainer:
             )
         wd = getattr(self, "_watchdog", None)
         wd_phase = f"train epoch {epoch}"
+        # straggler probe: LOCAL busy window — loader fetch/convert,
+        # batch assembly, injected stalls; anchored here and re-anchored
+        # at the END of each step body so the accumulation below covers
+        # everything up to the dispatch but nothing after it — the
+        # dispatch (and the guard reads / agreements behind it) can block
+        # inside the group's collectives waiting for the slowest peer,
+        # and sync SGD equalizes exactly the signal a straggler probe
+        # must not average away
+        t_anchor = time.perf_counter()
         for raw in loader:
             if skip_micro > 0:
                 skip_micro -= 1
@@ -1984,6 +2244,7 @@ class Trainer:
 
                 wd.beat(f"compile train step (epoch {epoch})",
                         allow_s=COMPILE_ALLOW_S)
+            self._local_busy_s += time.perf_counter() - t_anchor
             # step span: host wall-clock around the ASYNC dispatch, emitted
             # outside jit — no block_until_ready, no device_get (telemetry
             # adds zero device syncs; once the dispatch pipeline fills,
@@ -2032,12 +2293,17 @@ class Trainer:
                 self._deliver_preempt(sig)
             if self._agreed_preempt():
                 self._graceful_drain(epoch, epoch_pos)  # raises Preempted
+            # live observability (ISSUE 9): straggler probe + armed drift
+            # re-autotune, both at deterministic (group-uniform) steps
+            self._maybe_straggler_probe()
+            self._maybe_drift_reautotune()
             if max_steps is not None and epoch_pos >= max_steps:
                 break
             if self.iteration % log_interval == 0:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 dt = (time.time() - t_window) / max(window_iters, 1)
                 self._maybe_derive_agree_interval(dt)
+                self._observe_drift_window(dt)
                 global_batch = cfg.batch_size * self.data_size * nsteps
                 shown = {
                     k: v for k, v in metrics.items()
@@ -2062,6 +2328,11 @@ class Trainer:
                     )
                 t_window = time.time()
                 window_iters = 0
+            # re-anchor the local-busy window: everything between the
+            # pre-dispatch accumulation above and here (guard reads,
+            # agreements, checkpoints, metric pulls) is group-coupled
+            # and must stay OUT of the straggler signal
+            t_anchor = time.perf_counter()
         if micro:
             # trailing micro-batches short of a full nsteps_update group are
             # dropped; say so (SURVEY "no silent caps")
@@ -2644,6 +2915,10 @@ class Trainer:
             self.writer.close()
         if self.telemetry is not None:
             self.telemetry.close()
+        server = getattr(self, "_metrics_server", None)
+        if server is not None:
+            server.close()
+            self._metrics_server = None
 
     def load_checkpoint(self, directory: str, epoch: Optional[int] = None):
         """Restore a snapshot from a checkpoint dir onto this trainer's mesh

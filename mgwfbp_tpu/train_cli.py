@@ -100,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry-dir", dest="telemetry_dir", default=None,
                    help="directory for the telemetry event stream (default "
                         "<logdir>/<tag>; implies --telemetry)")
+    p.add_argument("--metrics-port", dest="metrics_port", type=int,
+                   default=None,
+                   help="live observability HTTP port (/metrics Prometheus, "
+                        "/healthz watchdog-wired liveness, /status run "
+                        "JSON); 0 = ephemeral, multi-host serves "
+                        "port+process_index per process; implies "
+                        "--telemetry (MGWFBP_METRICS_PORT)")
     p.add_argument("--compressor", default=None,
                    choices=["none", "topk"],
                    help="gradient compressor (reference --compressor)")
@@ -164,6 +171,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "num_steps", "num_batches_per_epoch", "compressor", "density",
             "comm_op", "dcn_slices", "autotune_steps", "schedule_cache",
             "telemetry_dir", "ckpt_every_steps", "bad_step_limit",
+            "metrics_port",
         )
         if getattr(args, k, None) is not None
     }
@@ -173,7 +181,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         overrides["grad_guard"] = False
     if args.tensorboard:
         overrides["tensorboard"] = True
-    if args.telemetry or args.telemetry_dir:
+    if args.telemetry or args.telemetry_dir or args.metrics_port is not None:
+        # the live plane's aggregator is fed by the event stream, so
+        # --metrics-port implies the stream (same as --telemetry-dir)
         overrides["telemetry"] = True
     if args.autotune:
         overrides["autotune"] = True

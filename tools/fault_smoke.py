@@ -22,6 +22,12 @@ This stage is what keeps the multi-host path from rotting back into dead
 code — the fate of the pre-ISSUE-6 multihost test, slow-marked and never
 run while CPU collectives silently stayed unconfigured.
 
+Both modes also smoke the LIVE observability plane (ISSUE 9): the
+single-process faulted run is probed mid-run over HTTP (/metrics must
+serve the live step counter, /healthz must answer 200), and the
+2-process group must serve DISTINCT ports (base + process_index), each
+reporting its own process_index in /status.
+
 Asserts the telemetry lifecycle after each run. No accelerator, dataset,
 or network needed.
 """
@@ -35,11 +41,28 @@ import os
 import subprocess
 import sys
 import tempfile
+import time
+import urllib.request
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
+from mgwfbp_tpu.runtime.supervisor import free_port as _free_port  # noqa: E402
+
 PREEMPT_RC = 75  # mirrors mgwfbp_tpu.utils.faults.PREEMPT_RC
+
+
+def _probe(port: int, path: str, timeout_s: float = 1.0):
+    """(http status, body) of one endpoint probe, or (None, reason)."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout_s
+        ) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:  # 503 from /healthz is an answer
+        return e.code, e.read().decode()
+    except Exception as e:  # noqa: BLE001 — not up yet
+        return None, str(e)
 
 
 def _cli(logdir: str) -> list[str]:
@@ -54,20 +77,55 @@ def _cli(logdir: str) -> list[str]:
     ]
 
 
-def _run(logdir: str, fault_plan: str) -> int:
+def _run(
+    logdir: str, fault_plan: str, metrics_port: int = 0,
+) -> tuple[int, dict]:
+    """One real-launcher run; with metrics_port > 0 the live plane is
+    probed WHILE the run is up (mid-run, not post-hoc — that is the whole
+    point of the plane). Returns (rc, probe results)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["MGWFBP_FAULT_PLAN"] = fault_plan
     env.setdefault(
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
     )
-    proc = subprocess.run(
-        _cli(logdir), env=env, cwd=_ROOT, capture_output=True, text=True,
-        timeout=600,
-    )
+    if metrics_port:
+        env["MGWFBP_METRICS_PORT"] = str(metrics_port)
+    # child output goes to FILES, not pipes: this loop does not drain
+    # while polling, and a chatty child filling a 64 KiB pipe buffer
+    # would block forever (a structural hang the old capture_output
+    # call never had)
+    out_path = os.path.join(logdir, "fault_smoke_child.log")
+    with open(out_path, "w") as sink:
+        proc = subprocess.Popen(
+            _cli(logdir), env=env, cwd=_ROOT,
+            stdout=sink, stderr=subprocess.STDOUT,
+        )
+        probes: dict = {}
+        deadline = time.monotonic() + 600
+        while proc.poll() is None:
+            if time.monotonic() > deadline:
+                proc.kill()
+                proc.wait()
+                raise AssertionError("fault-smoke run timed out")
+            if metrics_port and "metrics" not in probes:
+                code, body = _probe(metrics_port, "/metrics")
+                if code == 200 and "mgwfbp_steps_total" in body:
+                    probes["metrics"] = body
+                    code, body = _probe(metrics_port, "/healthz")
+                    assert code == 200, f"/healthz mid-run: {code} {body}"
+                    probes["healthz"] = body.strip()
+            time.sleep(0.1)
+    with open(out_path) as f:
+        tail = f.read()[-4000:]
     if proc.returncode not in (0, PREEMPT_RC):
-        sys.stderr.write(proc.stdout[-4000:] + proc.stderr[-4000:])
-    return proc.returncode
+        sys.stderr.write(tail)
+    if metrics_port:
+        assert "metrics" in probes, (
+            "live /metrics endpoint never answered mid-run "
+            f"(port {metrics_port}); child tail:\n" + tail
+        )
+    return proc.returncode, probes
 
 
 def _events(logdir: str) -> list[dict]:
@@ -82,10 +140,12 @@ def single_process() -> dict:
     from mgwfbp_tpu.telemetry import events_of
 
     with tempfile.TemporaryDirectory(prefix="mgwfbp_fault_smoke_") as d:
-        rc = _run(d, "nan@step=2;preempt@step=4")
+        port = _free_port()
+        rc, probes = _run(d, "nan@step=2;preempt@step=4", metrics_port=port)
         assert rc == PREEMPT_RC, (
             f"faulted run exited rc {rc}, want {PREEMPT_RC} (EX_TEMPFAIL)"
         )
+        assert probes.get("healthz") == "ok", probes
         recs = _events(d)
         bad = events_of(recs, "bad_step")
         assert bad and bad[0]["step"] == 2, f"bad_step missing/wrong: {bad}"
@@ -95,7 +155,7 @@ def single_process() -> dict:
         ckpts = events_of(recs, "checkpoint")
         assert any(c.get("mid_epoch") for c in ckpts), ckpts
 
-        rc = _run(d, "")
+        rc, _ = _run(d, "")
         assert rc == 0, f"resume run exited rc {rc}"
         recs = _events(d)
         resumes = events_of(recs, "resume")
@@ -111,6 +171,7 @@ def single_process() -> dict:
             "preempt_iteration": pre["iteration"],
             "resume_iteration": resumes[-1]["iteration"],
             "final_step": max(s["step"] for s in steps),
+            "live_metrics_probed": sorted(probes),
         }
 
 
@@ -129,6 +190,10 @@ def multi_process(processes: int) -> dict:
         # one plan for the whole group: NaN-poison a step on every
         # process, preempt ONLY process 1 — the drain must be agreed
         env["MGWFBP_FAULT_PLAN"] = "nan@step=2;preempt@step=4,proc=1"
+        # live plane: one configured base port; child i must serve
+        # base + i (telemetry/serve.resolve_metrics_port)
+        base_port = _free_port()
+        env["MGWFBP_METRICS_PORT"] = str(base_port)
         sup = Supervisor(
             default_train_cmd(_cli(d)[3:]),  # strip interpreter/-m/module
             processes,
@@ -136,8 +201,37 @@ def multi_process(processes: int) -> dict:
             log_dir=os.path.join(d, "supervisor"),
             env=env,
         )
-        rc = sup.run()
+        import threading
+
+        rc_box: dict = {}
+        runner = threading.Thread(
+            target=lambda: rc_box.update(rc=sup.run()), daemon=True
+        )
+        runner.start()
+        # mid-run: every process of the group serves a DISTINCT port,
+        # each reporting its own process_index in /status
+        served: dict = {}
+        deadline = time.monotonic() + 590
+        while runner.is_alive() and len(served) < processes:
+            if time.monotonic() > deadline:
+                break
+            for i in range(processes):
+                if i in served:
+                    continue
+                code, body = _probe(base_port + i, "/status")
+                if code == 200:
+                    served[i] = json.loads(body)
+            time.sleep(0.1)
+        runner.join(timeout=600)
+        assert not runner.is_alive(), "supervised group wedged"
+        rc = rc_box.get("rc")
         assert rc == 0, f"supervised group finished rc {rc}, want 0"
+        assert set(served) == set(range(processes)), (
+            f"live /status never answered on every per-process port "
+            f"(base {base_port}): got {sorted(served)}"
+        )
+        for i, st in served.items():
+            assert st["run"]["process_index"] == i, (i, st["run"])
         assert len(sup.results) == 2, (
             f"expected preempt + 1 resubmission, got "
             f"{[r.returncodes for r in sup.results]}"
@@ -181,6 +275,7 @@ def multi_process(processes: int) -> dict:
             "incarnations": [r.returncodes for r in sup.results],
             "merged_records": len(merged),
             "preempt_signals": signals,
+            "metrics_ports": [base_port + i for i in range(processes)],
         }
 
 

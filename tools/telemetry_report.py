@@ -12,6 +12,10 @@ with ``--telemetry`` on the train CLI) and prints:
     CPU mesh, whose traces drop the name stack);
   * the aggregate overlap-efficiency number (hidden / total comm — the
     paper's headline metric);
+  * the alarms table — cost-model drift rows (kind, merge group, residual
+    vs band) and live straggler rows (slow process, excess) from the
+    drift detector / multi-host probe (telemetry/drift.py), raise and
+    clear edges both;
   * lifecycle events: resizes (and which schedule path won), checkpoints,
     autotune race rows, watchdog stalls, bench skips.
 
@@ -132,6 +136,35 @@ def format_report(records: list[dict]) -> str:
         lines.append("overlap: no snapshot recorded (single-device run, "
                      "policy 'none', or telemetry off during fit)")
 
+    alarms = events_of(records, "drift_alarm", "straggler")
+    if alarms:
+        lines.append("")
+        lines.append("alarms:")
+        lines.append(
+            f"  {'kind':>14} {'edge':>6} {'group/proc':>10} "
+            f"{'residual':>10} {'band':>8} {'step':>8}"
+        )
+        for r in alarms:
+            if r.get("event") == "drift_alarm":
+                kind = str(r.get("kind"))
+                who = (
+                    str(r.get("group"))
+                    if int(r.get("group", -1)) >= 0 else "agg"
+                )
+                residual = _fmt_s(r.get("residual"))
+                band = _fmt_s(r.get("band"))
+            else:
+                kind = "straggler"
+                who = f"p{r.get('slow_process')}"
+                residual = _fmt_s(r.get("excess_s"))
+                band = "-"
+            lines.append(
+                f"  {kind:>14} "
+                f"{'RAISE' if r.get('active') else 'clear':>6} "
+                f"{who:>10} {residual:>10} {band:>8} "
+                f"{str(r.get('step', '-')):>8}"
+            )
+
     lifecycle = []
     for ev, render in (
         ("resize", lambda r: (
@@ -208,6 +241,12 @@ def _synthetic_stream(path: str) -> None:
     w.emit("resize", old_world=8, new_world=4,
            schedule_source="schedule-cache", num_groups=2)
     w.emit("checkpoint", epoch=0, iteration=24, mid_epoch=False)
+    w.emit("drift_alarm", kind="comm_residual", step=20, residual=4.5,
+           band=3.0, active=True, group=1)
+    w.emit("drift_alarm", kind="comm_residual", step=23, residual=1.2,
+           band=3.0, active=False, group=1)
+    w.emit("straggler", step=22, slow_process=1, excess_s=0.013,
+           step_s_max=0.058, step_s_min=0.045, active=True)
     w.close()
 
 
@@ -224,6 +263,7 @@ def selftest() -> int:
         records = read_events(path)
         report = format_report(records)
         assert "overlap efficiency" in report, report
+        assert "alarms:" in report and "straggler" in report, report
         trace_path = os.path.join(d, "trace.json")
         doc = write_chrome_trace(trace_path, records)
         with open(trace_path) as f:
@@ -232,6 +272,16 @@ def selftest() -> int:
         prom = write_prometheus(os.path.join(d, "metrics.prom"), records)
         assert "mgwfbp_steps_total 24" in prom, prom
         assert "mgwfbp_overlap_efficiency" in prom
+        # the file dump and the live /metrics endpoint share ONE registry
+        # + aggregator; serving the replayed stream must render the very
+        # same text (ISSUE 9: the two surfaces cannot diverge)
+        from mgwfbp_tpu.telemetry.export import render_metrics
+        from mgwfbp_tpu.telemetry.serve import MetricsAggregator
+
+        agg = MetricsAggregator()
+        agg.replay(records)
+        assert render_metrics(agg.values()) == prom
+        assert "mgwfbp_drift_alarms_total 1" in prom, prom
         print(report)
         print()
         print(
