@@ -788,31 +788,42 @@ def load_layer_profile(path: str) -> dict:
     return d
 
 
-def trace_group_times(
-    run_steps: Callable[[], None],
-    num_groups: int,
-    iters: int = 1,
-    logdir: Optional[str] = None,
+def hlo_collective_scope_map(
+    hlo_text: str, tag: str = "mgwfbp_group",
+) -> dict[str, str]:
+    """HLO instruction name -> merge-group scope, from COMPILED
+    (post-optimization) HLO text.
+
+    Backends that drop the jax name stack from profiler-trace event
+    metadata (the virtual CPU mesh) still name each trace event after the
+    HLO instruction it executed (``all-reduce.2``), and the compiled
+    module's text keeps every instruction's ``metadata={op_name=...}`` —
+    which carries the ``mgwfbp_groupNNNN`` scope the jaxpr verifier
+    matches on. This map is the join key between the two: it lets
+    `trace_group_times` attribute device time per merge group even where
+    the name-stack path yields nothing (the live /profile endpoint's
+    CPU-mesh regime)."""
+    import re as _re
+
+    instr = _re.compile(r"%([\w.\-]+)\s*=\s")
+    scope = _re.compile(rf"op_name=\"[^\"]*?({_re.escape(tag)}\d+)")
+    out: dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        m = instr.search(line)
+        if m is None:
+            continue
+        s = scope.search(line)
+        if s is not None:
+            out[m.group(1)] = s.group(1)
+    return out
+
+
+def _group_times_from_scopes(
+    rows: Sequence[tuple[str, float]], num_groups: int, iters: int,
 ) -> Optional[list[float]]:
-    """Measured per-merge-group wall-clock from a profiler trace.
-
-    run_steps() must execute `iters` live training steps (carrying state)
-    and block until done; every device op a merge group issues carries its
-    `mgwfbp_groupNNNN` name scope in the op metadata (the same introspection
-    hook the jaxpr verifier matches on), so each group's time is the sum of
-    its scoped event durations, averaged over the traced steps.
-
-    Returns arrival-order seconds per group per step, or None when the
-    trace attributes nothing for some group — backends that drop the name
-    stack from op metadata (the virtual CPU mesh) land here, and the
-    autotuner falls back to step-time deltas
-    (`autotune.step_delta_observations`).
-    """
-    rows = _with_trace_events(
-        run_steps, logdir, prefix="mgwfbp_group_trace_"
-    )
-    if not rows:
-        return None
+    """The direct name-stack attribution: each group's time is the sum of
+    the event durations whose identifier carries its scope, averaged over
+    the traced steps (real TPU op metadata keeps the scope)."""
     from mgwfbp_tpu.parallel.allreduce import group_scope_name
 
     out: list[float] = []
@@ -822,6 +833,85 @@ def trace_group_times(
         if dur_us <= 0.0:
             return None  # partial attribution is worse than none
         out.append(dur_us * 1e-6 / max(iters, 1))
+    return out
+
+
+def _group_times_from_hlo_join(
+    rows: Sequence[tuple[str, float]],
+    num_groups: int,
+    hlo_text: str,
+) -> Optional[list[float]]:
+    """Attribution fallback via the compiled-HLO join
+    (`hlo_collective_scope_map`): trace events are matched by HLO
+    instruction NAME, and each instruction's MEAN event duration is its
+    per-device per-step time (one event per device per traced step, so
+    the mean normalizes over both `iters` and device multiplicity —
+    unlike the scope path, whose per-device traces carry only local
+    events). A group's time is the sum over its instructions (rs/ag legs
+    count once each). Returns None when any group attributes nothing."""
+    from mgwfbp_tpu.parallel.allreduce import group_scope_name
+
+    scope_map = hlo_collective_scope_map(hlo_text)
+    if not scope_map:
+        return None
+    per_instr: dict[str, tuple[float, int]] = {}
+    for ident, dur in rows:
+        name = ident.split(" ", 1)[0]
+        if name in scope_map:
+            t, c = per_instr.get(name, (0.0, 0))
+            per_instr[name] = (t + dur, c + 1)
+    out: list[float] = []
+    for gi in range(num_groups):
+        tag = group_scope_name(gi)
+        total_us = 0.0
+        found = False
+        for name, sc in scope_map.items():
+            if sc != tag or name not in per_instr:
+                continue
+            t, c = per_instr[name]
+            total_us += t / max(c, 1)
+            found = True
+        if not found:
+            return None
+        out.append(total_us * 1e-6)
+    return out
+
+
+def trace_group_times(
+    run_steps: Callable[[], None],
+    num_groups: int,
+    iters: int = 1,
+    logdir: Optional[str] = None,
+    hlo_text: Optional[str] = None,
+) -> Optional[list[float]]:
+    """Measured per-merge-group wall-clock from a profiler trace.
+
+    run_steps() must execute `iters` live training steps (carrying state)
+    and block until done; every device op a merge group issues carries its
+    `mgwfbp_groupNNNN` name scope in the op metadata (the same introspection
+    hook the jaxpr verifier matches on), so each group's time is the sum of
+    its scoped event durations, averaged over the traced steps.
+
+    With ``hlo_text`` (the COMPILED text of the step being traced), a
+    backend whose trace events drop the name stack still attributes: the
+    events are named after HLO instructions, and the compiled module's
+    per-instruction ``op_name`` metadata recovers each collective's group
+    scope (`hlo_collective_scope_map` — the live /profile endpoint's
+    CPU-mesh path).
+
+    Returns arrival-order seconds per group per step, or None when the
+    trace attributes nothing for some group on EITHER path — the
+    autotuner then falls back to step-time deltas
+    (`autotune.step_delta_observations`).
+    """
+    rows = _with_trace_events(
+        run_steps, logdir, prefix="mgwfbp_group_trace_"
+    )
+    if not rows:
+        return None
+    out = _group_times_from_scopes(rows, num_groups, iters)
+    if out is None and hlo_text:
+        out = _group_times_from_hlo_join(rows, num_groups, hlo_text)
     return out
 
 

@@ -166,6 +166,30 @@ def gather_values(value: float) -> list[float]:
     return [float(t) for t in _device_reduce(row, "sum")]
 
 
+def gather_vectors(values: Sequence[float]) -> list[list[float]]:
+    """Every process's float VECTOR, in process order, identical
+    everywhere — `gather_values` for per-group payloads (the on-demand
+    deep-profile window gathers each process's trace-attributed per-group
+    device seconds). Every process must pass the SAME length (the
+    lockstep-shape contract all primitives here carry; merge-group count
+    is group-uniform by construction). One-hot block rows summed through
+    the same transport."""
+    row = [float(v) for v in values]
+    n = process_count()
+    if n == 1:
+        return [row]
+    k = len(row)
+    if k == 0:
+        return [[] for _ in range(n)]
+    flat = [0.0] * (n * k)
+    start = process_index() * k
+    flat[start:start + k] = row
+    reduced = _device_reduce(flat, "sum")
+    return [
+        [float(t) for t in reduced[i * k:(i + 1) * k]] for i in range(n)
+    ]
+
+
 def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
     """Agreed argmin over per-candidate timings.
 

@@ -53,6 +53,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=None,
                    help="coordinator port (default: pick a free one per "
                         "incarnation)")
+    p.add_argument("--fleet-port", dest="fleet_port", type=int,
+                   default=None,
+                   help="serve the group-level fan-in here "
+                        "(/fleet/metrics merges every child's registry "
+                        "metrics under a process label, /fleet/status "
+                        "the live straggler table + group alarms; 0 = "
+                        "ephemeral). Needs MGWFBP_METRICS_PORT exported "
+                        "for the children")
+    p.add_argument("--fleet-file", dest="fleet_file", default=None,
+                   help="persist the children's ACTUAL metrics endpoints "
+                        "here in Prometheus http_sd format (default: "
+                        "<log-dir>/fleet.json when --log-dir is set)")
     p.add_argument("train_args", nargs=argparse.REMAINDER,
                    help="arguments for mgwfbp_tpu.train_cli (prefix "
                         "with --)")
@@ -74,6 +86,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         drain_grace_s=args.drain_grace,
         log_dir=args.log_dir,
         port=args.port,
+        fleet_port=args.fleet_port,
+        fleet_file=args.fleet_file,
     )
     return sup.run()
 

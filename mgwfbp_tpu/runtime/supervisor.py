@@ -34,6 +34,17 @@ still-reachable child's last /status snapshot in the stop message — the
 dead group's final state lands in the supervisor log next to the stack
 dumps it points at.
 
+Fleet console (ISSUE 10): the supervisor exports a per-child
+MGWFBP_METRICS_PORT_FILE so every child persists its ACTUAL bound port
+(covering the MGWFBP_METRICS_PORT=0 ephemeral case, where the
+port+process_index convention is simply wrong); the resolved targets are
+persisted to a `fleet.json` sidecar in Prometheus http_sd/file_sd format,
+and — with ``fleet_port`` set (`supervise --fleet-port`) — served live as
+the group-level fan-in: /fleet/metrics merges every child's registry
+metrics under a ``process`` label, /fleet/status synthesizes the live
+straggler table, slowest-process attribution, and the group's active
+alarms (telemetry/fleet.py).
+
 `python -m mgwfbp_tpu.runtime.supervise --processes 2 -- <train args>`
 is the CLI (see runtime/supervise.py).
 """
@@ -111,6 +122,8 @@ class Supervisor:
         log_dir: Optional[str] = None,
         env: Optional[dict] = None,
         port: Optional[int] = None,
+        fleet_port: Optional[int] = None,
+        fleet_file: Optional[str] = None,
         sleep: Callable[[float], None] = time.sleep,
     ):
         if processes < 1:
@@ -132,6 +145,15 @@ class Supervisor:
         # the moment an rc-86 exit is first observed (None = no abort
         # seen yet this incarnation)
         self._status_snapshots: Optional[dict] = None
+        # fleet console (ISSUE 10): fan-in server port (None = off,
+        # 0 = ephemeral), http_sd sidecar path, port-file directory
+        self.fleet_port = fleet_port
+        self.fleet_file = fleet_file or (
+            os.path.join(log_dir, "fleet.json") if log_dir else None
+        )
+        self.fleet_server = None
+        self._ports_dir: Optional[str] = None
+        self._last_fleet_targets: Optional[dict] = None
 
     # -- launch ------------------------------------------------------------
     def _metrics_base_port(self) -> Optional[int]:
@@ -148,18 +170,132 @@ class Supervisor:
             return None
         return base if base > 0 else None
 
+    def _metrics_enabled(self) -> bool:
+        """True when the group's live plane is configured at all
+        (MGWFBP_METRICS_PORT set to anything, including 0/ephemeral)."""
+        raw = (self.env.get("MGWFBP_METRICS_PORT") or "").strip()
+        if not raw:
+            return False
+        try:
+            return int(raw) >= 0
+        except ValueError:
+            return False
+
+    def _port_file(self, idx: int) -> str:
+        """Per-child metrics port-file sidecar path (the child's
+        telemetry/serve writes its ACTUAL bound port there)."""
+        if self._ports_dir is None:
+            if self.log_dir:
+                self._ports_dir = self.log_dir
+                os.makedirs(self._ports_dir, exist_ok=True)
+            else:
+                import tempfile
+
+                self._ports_dir = tempfile.mkdtemp(
+                    prefix="mgwfbp_fleet_ports_"
+                )
+        return os.path.join(self._ports_dir, f"metrics_port.p{idx}.json")
+
+    def _child_targets(self) -> dict:
+        """process index -> (host, port) of every currently-resolvable
+        child metrics endpoint: the child-written port file (the ACTUAL
+        bound port — authoritative, and the only source in the ephemeral
+        base==0 case), falling back to the base+index convention for
+        children that have not bound yet."""
+        if not self._metrics_enabled():
+            return {}
+        import json as _json
+
+        base = self._metrics_base_port()
+        targets: dict = {}
+        for i in range(self.processes):
+            path = self._port_file(i)
+            try:
+                with open(path) as f:
+                    doc = _json.load(f)
+                targets[i] = (
+                    str(doc.get("host") or "127.0.0.1"),
+                    int(doc["port"]),
+                )
+                continue
+            except (OSError, ValueError, KeyError, TypeError):
+                pass
+            if base is not None:
+                targets[i] = ("127.0.0.1", base + i)
+        return targets
+
+    def _refresh_fleet(self) -> None:
+        """Re-resolve the child target map; persist `fleet.json`
+        (Prometheus http_sd format) whenever it changes. Called from the
+        `_watch` poll loop — targets appear as children bind their
+        (possibly ephemeral) ports and write their port files."""
+        if not self._metrics_enabled():
+            return
+        targets = self._child_targets()
+        if targets == self._last_fleet_targets:
+            return
+        if self.fleet_file and targets:
+            from mgwfbp_tpu.telemetry.fleet import write_fleet_sd
+
+            try:
+                write_fleet_sd(self.fleet_file, targets)
+            except OSError as e:
+                # do NOT record the targets: the sidecar is stale, and a
+                # stable group would otherwise never retry the write
+                self.log.warning(
+                    "could not write fleet sidecar %s: %s",
+                    self.fleet_file, e,
+                )
+                return
+            self.log.info(
+                "fleet targets -> %s (%s)", self.fleet_file,
+                ", ".join(
+                    f"p{i}={h}:{p}"
+                    for i, (h, p) in sorted(targets.items())
+                ),
+            )
+        self._last_fleet_targets = dict(targets)
+
+    def _fleet_meta(self) -> dict:
+        """Supervisor-level fields for /fleet/status."""
+        return {
+            "incarnation": len(self.results),
+            "processes_configured": self.processes,
+        }
+
+    def _start_fleet_server(self) -> None:
+        """One fan-in server for the supervisor's lifetime (targets
+        re-resolve per request, so resubmitted incarnations with fresh
+        ephemeral ports keep being reachable through the same URL)."""
+        if self.fleet_port is None or self.fleet_server is not None:
+            return
+        if not self._metrics_enabled():
+            self.log.warning(
+                "fleet fan-in requested but MGWFBP_METRICS_PORT is not "
+                "set for the children; /fleet endpoints disabled"
+            )
+            return
+        from mgwfbp_tpu.telemetry.fleet import start_fleet_server
+
+        self.fleet_server = start_fleet_server(
+            self._child_targets, self.fleet_port,
+            meta_provider=self._fleet_meta,
+        )
+
     def _child_status(self, idx: int, timeout_s: float = 2.0):
         """Last /status snapshot of child `idx`, or None when the plane
-        is off / the child is gone."""
-        base = self._metrics_base_port()
-        if base is None:
+        is off / the child is gone. Resolves the child's REAL endpoint
+        through the port-file map (ephemeral ports included)."""
+        target = self._child_targets().get(idx)
+        if target is None:
             return None
         import json as _json
         import urllib.request
 
+        host, port = target
         try:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{base + idx}/status", timeout=timeout_s
+                f"http://{host}:{port}/status", timeout=timeout_s
             ) as resp:
                 return _json.loads(resp.read().decode())
         except Exception:  # noqa: BLE001 — a dead child's port refusing
@@ -171,6 +307,11 @@ class Supervisor:
         env["MGWFBP_COORDINATOR"] = f"127.0.0.1:{port}"
         env["MGWFBP_NUM_PROCESSES"] = str(self.processes)
         env["MGWFBP_PROCESS_ID"] = str(idx)
+        if self._metrics_enabled():
+            # the child persists its ACTUAL bound metrics port here
+            # (telemetry/serve.write_port_file) — the fleet fan-in and
+            # fleet.json read real ports, never the base+index guess
+            env["MGWFBP_METRICS_PORT_FILE"] = self._port_file(idx)
         return env
 
     def _spawn(self, idx: int, incarnation: int, port: int):
@@ -196,6 +337,17 @@ class Supervisor:
             "incarnation %d: launching %d process(es) (coordinator "
             "127.0.0.1:%d)", incarnation, self.processes, port,
         )
+        if self._metrics_enabled():
+            # stale port files describe the PREVIOUS incarnation's
+            # (possibly ephemeral) binds; drop them so the fan-in never
+            # scrapes a dead port as live
+            for i in range(self.processes):
+                try:
+                    os.unlink(self._port_file(i))
+                except OSError:
+                    pass
+            self._last_fleet_targets = None
+            self._start_fleet_server()
         metrics_base = self._metrics_base_port()
         if metrics_base is not None:
             for i in range(self.processes):
@@ -233,6 +385,10 @@ class Supervisor:
         deadline = None  # armed on the first exit of any kind
         grace = None
         while True:
+            # lazily resolve child metrics endpoints as they bind and
+            # keep the fleet.json sidecar current (no-op when the live
+            # plane is off or nothing changed)
+            self._refresh_fleet()
             pending = [p for p in procs if p.poll() is None]
             if not pending:
                 return [int(p.returncode) for p in procs]
@@ -296,6 +452,14 @@ class Supervisor:
         )
 
     def run(self) -> int:
+        try:
+            return self._run_policy()
+        finally:
+            if self.fleet_server is not None:
+                self.fleet_server.close()
+                self.fleet_server = None
+
+    def _run_policy(self) -> int:
         restarts = 0
         incarnation = 0
         while True:

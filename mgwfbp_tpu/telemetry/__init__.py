@@ -29,6 +29,15 @@ from mgwfbp_tpu.telemetry.events import (
     read_events,
     stream_filename,
 )
+from mgwfbp_tpu.telemetry.fleet import (
+    ChildScrape,
+    FleetServer,
+    fleet_status,
+    render_fleet_metrics,
+    scrape_fleet,
+    start_fleet_server,
+    write_fleet_sd,
+)
 from mgwfbp_tpu.telemetry.overlap import (
     GroupOverlap,
     OverlapSummary,
@@ -47,6 +56,13 @@ __all__ = [
     "DriftConfig",
     "DriftDetector",
     "StragglerDetector",
+    "ChildScrape",
+    "FleetServer",
+    "fleet_status",
+    "render_fleet_metrics",
+    "scrape_fleet",
+    "start_fleet_server",
+    "write_fleet_sd",
     "MetricsAggregator",
     "TelemetryServer",
     "start_metrics_server",

@@ -107,6 +107,11 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # that key). excess_s = slowest minus fastest window step seconds.
     "straggler": ("step", "slow_process", "excess_s", "step_s_max",
                   "step_s_min", "active"),
+    # --- fleet console + deep profiling (ISSUE 10) ----------------------
+    # one completed on-demand /profile trace window: `steps` live steps
+    # traced, `attribution` 'trace' when per-group device time attributed
+    # (device_s rides along per group, layout order) or 'none'
+    "profile": ("step", "steps", "attribution"),
 }
 
 _JSON_SCALARS = (str, int, float, bool, type(None))

@@ -247,6 +247,17 @@ METRICS: tuple[tuple[str, str, str], ...] = (
      "step seconds"),
     ("mgwfbp_active_alarms", "gauge",
      "currently-active drift/straggler alarms"),
+    ("mgwfbp_profile_windows_total", "counter",
+     "on-demand /profile trace windows completed"),
+    # fleet fan-in synthesis (rendered only by telemetry/fleet.py's
+    # /fleet/metrics, never by a per-process endpoint — registered here
+    # so the fleet exposition flows through the same single registry)
+    ("mgwfbp_fleet_processes", "gauge",
+     "child processes answering the fleet fan-in scrape"),
+    ("mgwfbp_fleet_unreachable", "gauge",
+     "child processes that failed the fleet fan-in scrape"),
+    ("mgwfbp_fleet_straggler_excess_seconds", "gauge",
+     "slowest minus fastest process mean step seconds (live fan-in)"),
 )
 
 # event type -> counter metric (shared by the aggregator's incremental
@@ -263,6 +274,7 @@ EVENT_COUNTERS: dict[str, str] = {
     "rollback": "mgwfbp_rollbacks_total",
     "preempt": "mgwfbp_preempts_total",
     "resume": "mgwfbp_resumes_total",
+    "profile": "mgwfbp_profile_windows_total",
 }
 
 
@@ -289,6 +301,79 @@ def render_metrics(values: dict) -> str:
         lines.append(f"{name} {v:g}" if isinstance(v, float)
                      else f"{name} {v}")
     return "\n".join(lines) + "\n"
+
+
+def render_labeled_metrics(
+    series: dict[str, dict],
+    label: str = "process",
+    extra: Optional[dict] = None,
+) -> str:
+    """Prometheus text exposition of SEVERAL processes' metric values
+    merged under one label (the fleet fan-in's /fleet/metrics): for each
+    registry metric, HELP/TYPE once, then one ``name{label="key"} value``
+    line per series that carries it. ``extra`` holds unlabeled fleet-level
+    values (the mgwfbp_fleet_* gauges). Same registry, same stray-name
+    rejection as `render_metrics` — the fleet render and the per-process
+    render flow through ONE metric statement and cannot drift."""
+    known = {name for name, _, _ in METRICS}
+    stray = set(extra or {}) - known
+    for key, values in series.items():
+        stray |= set(values) - known
+    if stray:
+        raise ValueError(
+            f"metrics {sorted(stray)} are not in telemetry.export.METRICS; "
+            "register them there so every exposition surface shows them"
+        )
+    extra = extra or {}
+    lines: list[str] = []
+    for name, kind, help_ in METRICS:
+        rows: list[str] = []
+        for key in sorted(series, key=str):
+            values = series[key]
+            if name not in values:
+                continue
+            v = values[name]
+            val = f"{v:g}" if isinstance(v, float) else str(v)
+            rows.append(f'{name}{{{label}="{key}"}} {val}')
+        if name in extra:
+            v = extra[name]
+            val = f"{v:g}" if isinstance(v, float) else str(v)
+            rows.append(f"{name} {val}")
+        if not rows:
+            continue
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(rows)
+    return "\n".join(lines) + "\n"
+
+
+def parse_metrics_text(text: str) -> dict:
+    """`render_metrics`'s inverse: registry-named values from one
+    process's Prometheus text exposition (the fleet fan-in scrapes child
+    /metrics endpoints and re-renders them labeled). Unregistered names
+    raise — a child exposing metrics this build's registry does not know
+    means mismatched versions, which the operator should see, not a
+    silently dropped series."""
+    known = {name for name, _, _ in METRICS}
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            raise ValueError(f"unparseable metrics line: {line!r}")
+        name, raw = parts
+        if name not in known:
+            raise ValueError(
+                f"metric {name!r} is not in telemetry.export.METRICS "
+                "(scraped child runs a different registry version?)"
+            )
+        try:
+            out[name] = int(raw)
+        except ValueError:
+            out[name] = float(raw)
+    return out
 
 
 def prometheus_text(records: list[dict]) -> str:

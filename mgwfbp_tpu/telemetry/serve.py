@@ -29,7 +29,17 @@ is slow. This module serves them per process:
       /status    JSON: run metadata, current step/epoch, the committed
                  merge schedule + comm_op, rolling overlap efficiency,
                  last checkpoint, bad-step/rollback counts, active
-                 drift/straggler alarms.
+                 drift/straggler alarms, profile-window state;
+      /profile   on-demand deep profiling (ISSUE 10): ``?steps=N`` arms a
+                 bounded ``jax.profiler.trace`` window over the next N
+                 live steps — the handler only flips host state; the step
+                 loop runs the window at the next (multi-host: group-
+                 agreed) boundary, writes a Chrome-trace slice, and posts
+                 the per-merge-group device-attributed table back here.
+
+  * The fleet fan-in (`telemetry/fleet.py`, served by the supervisor)
+    scrapes these per-process endpoints and merges them under a
+    ``process`` label through the SAME metric registry.
 
 The server thread only ever reads the aggregator under its lock — it
 issues no device calls, touches no jax state, and a dead server (port
@@ -51,6 +61,16 @@ from mgwfbp_tpu.utils.logging import get_logger
 
 METRICS_PORT_ENV = "MGWFBP_METRICS_PORT"
 METRICS_HOST_ENV = "MGWFBP_METRICS_HOST"
+# where to persist this process's ACTUAL bound port (JSON sidecar): the
+# supervisor exports one path per child so the fleet fan-in and fleet.json
+# never have to guess ports — the base+index convention cannot cover the
+# ephemeral (base == 0) case at all
+METRICS_PORT_FILE_ENV = "MGWFBP_METRICS_PORT_FILE"
+
+# hard ceiling on one /profile window: the endpoint is unauthenticated on
+# loopback and the window syncs the device, so a request may never arm an
+# unbounded trace
+PROFILE_MAX_STEPS = 50
 
 # rolling window for the mean-step gauge — matches the historical
 # prometheus_text behavior (mean over the last <= 20 step spans)
@@ -120,6 +140,17 @@ class MetricsAggregator:
         # abort-bound stall landed (the process is about to os._exit(86))
         self._unhealthy: Optional[str] = None
         self._unhealthy_sticky = False
+        # on-demand deep profiling (/profile?steps=N): the HTTP handler
+        # only ARMS a request here; the trainer's step loop consumes it
+        # at the next (group-agreed, on multi-host) step boundary and
+        # posts the result back — the handler thread itself never touches
+        # jax. `_profile_supported` flips True when a live trainer
+        # attaches; a replay-only aggregator rejects arming.
+        self._profile_supported = False
+        self._profile_state = "idle"  # idle|armed|running|done|failed
+        self._profile_steps: Optional[int] = None
+        self._profile_result: Optional[dict] = None
+        self._profile_error: Optional[str] = None
 
     # -- feeding -----------------------------------------------------------
     def observe(self, event: str, fields: dict) -> None:
@@ -208,6 +239,82 @@ class MetricsAggregator:
                 self._schedule["predicted_nonoverlap_s"] = float(
                     predicted_nonoverlap_s
                 )
+
+    # -- on-demand deep profiling (/profile) -------------------------------
+    def enable_profile(self) -> None:
+        """A live trainer attached: /profile?steps=N requests now have a
+        consumer (the step loop polls `take_profile_request`)."""
+        with self._lock:
+            self._profile_supported = True
+
+    def arm_profile(self, steps) -> tuple[int, dict]:
+        """Arm a bounded trace window for the next `steps` live steps
+        (the HTTP handler's side). Returns (http status, response doc)."""
+        with self._lock:
+            if not self._profile_supported:
+                return 409, {
+                    "error": "no live trainer attached to this endpoint "
+                             "(replay-only aggregator cannot profile)",
+                }
+            try:
+                n = int(steps)
+            except (TypeError, ValueError):
+                return 400, {"error": f"steps={steps!r} is not an integer"}
+            if n < 1:
+                return 400, {"error": f"steps must be >= 1, got {n}"}
+            if self._profile_state in ("armed", "running"):
+                return 409, {
+                    "error": f"a profile window is already "
+                             f"{self._profile_state}",
+                    "state": self._profile_state,
+                }
+            n = min(n, PROFILE_MAX_STEPS)
+            self._profile_state = "armed"
+            self._profile_steps = n
+            self._profile_error = None
+            return 200, {
+                "armed": True, "steps": n,
+                "max_steps": PROFILE_MAX_STEPS,
+            }
+
+    def take_profile_request(self) -> Optional[int]:
+        """Consume an armed request (the trainer's step loop; host-only,
+        one lock acquire — the disarmed path stays zero-sync)."""
+        with self._lock:
+            if self._profile_state != "armed":
+                return None
+            self._profile_state = "running"
+            return self._profile_steps
+
+    def set_profile_result(self, result: dict) -> None:
+        with self._lock:
+            self._profile_state = "done"
+            self._profile_result = dict(result)
+            self._profile_error = None
+
+    def fail_profile(self, reason: str) -> None:
+        with self._lock:
+            self._profile_state = "failed"
+            self._profile_error = str(reason)
+
+    def profile_status(self) -> dict:
+        """The /profile GET document (no query = status/result)."""
+        with self._lock:
+            return self._profile_status_locked()
+
+    def _profile_status_locked(self) -> dict:
+        out: dict = {
+            "supported": self._profile_supported,
+            "state": self._profile_state,
+            "max_steps": PROFILE_MAX_STEPS,
+        }
+        if self._profile_state in ("armed", "running"):
+            out["steps"] = self._profile_steps
+        if self._profile_result is not None:
+            out["result"] = dict(self._profile_result)
+        if self._profile_error is not None:
+            out["error"] = self._profile_error
+        return out
 
     # -- reading -----------------------------------------------------------
     def values(self) -> dict:
@@ -298,14 +405,18 @@ class MetricsAggregator:
                 "active_alarms": [
                     dict(a) for a in self._active_alarms.values()
                 ],
+                "profile": self._profile_status_locked(),
             }
 
 
 class _Handler(BaseHTTPRequestHandler):
     # the aggregator is attached to the server instance by TelemetryServer
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        from urllib.parse import parse_qs, urlsplit
+
         agg: MetricsAggregator = self.server.aggregator  # type: ignore
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        split = urlsplit(self.path)
+        path = split.path.rstrip("/") or "/"
         if path == "/metrics":
             from mgwfbp_tpu.telemetry.export import render_metrics
 
@@ -317,12 +428,25 @@ class _Handler(BaseHTTPRequestHandler):
             body = (reason + "\n").encode()
             ctype = "text/plain; charset=utf-8"
             code = 200 if healthy else 503
+        elif path == "/profile":
+            # ?steps=N arms a bounded trace window on the live trainer
+            # (consumed at the next step boundary — next agree-interval
+            # boundary on a multi-host group); no query = status/result
+            query = parse_qs(split.query)
+            if "steps" in query:
+                code, doc = agg.arm_profile(query["steps"][-1])
+            else:
+                code, doc = 200, agg.profile_status()
+            body = (json.dumps(doc, indent=1) + "\n").encode()
+            ctype = "application/json"
         elif path in ("/status", "/"):
             body = (json.dumps(agg.status(), indent=1) + "\n").encode()
             ctype = "application/json"
             code = 200
         else:
-            body = b"not found: serve /metrics, /healthz, /status\n"
+            body = (
+                b"not found: serve /metrics, /healthz, /status, /profile\n"
+            )
             ctype = "text/plain; charset=utf-8"
             code = 404
         self.send_response(code)
@@ -381,6 +505,26 @@ class TelemetryServer:
             self._thread = None
 
 
+def write_port_file(
+    path: str, server: TelemetryServer, process_index: int,
+) -> None:
+    """Persist the ACTUAL bound endpoint (atomic JSON sidecar) so the
+    supervisor's fleet fan-in and the `fleet.json` scrape targets read
+    real ports instead of assuming the base+index convention — which is
+    simply wrong when the base is 0 (per-process ephemeral ports)."""
+    doc = {
+        "process": int(process_index),
+        "host": server.host,
+        "port": int(server.port),
+        "pid": os.getpid(),
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
 def start_metrics_server(
     aggregator: MetricsAggregator,
     base_port: Optional[int],
@@ -404,8 +548,15 @@ def start_metrics_server(
             "observability disabled for this process", port, e,
         )
         return None
+    port_file = (os.environ.get(METRICS_PORT_FILE_ENV) or "").strip()
+    if port_file:
+        try:
+            write_port_file(port_file, server, process_index)
+        except OSError as e:  # the sidecar is a convenience, not a gate
+            log.warning("could not write metrics port file %s: %s",
+                        port_file, e)
     log.info(
-        "metrics server: http://%s:%d (/metrics /healthz /status)",
+        "metrics server: http://%s:%d (/metrics /healthz /status /profile)",
         server.host, server.port,
     )
     return server

@@ -505,6 +505,9 @@ class Trainer:
         # rebuilds mid-run) — restore the watchdog's compile allowance
         self._train_step_compiled = False
         self._eval_step_compiled = False
+        # compiled-HLO text of the live step (the /profile window's
+        # trace-event join key) describes the OLD program
+        self._step_hlo_cache = None
 
     def _build_run_sinks(self) -> None:
         """(Re)bind every tag-addressed output — log file, checkpoint dir,
@@ -597,6 +600,10 @@ class Trainer:
         agg = getattr(self, "_metrics_agg", None)
         if agg is not None and self.telemetry is not None:
             self.telemetry.observer = agg.observe
+        if agg is not None:
+            # a live trainer is attached: /profile?steps=N requests now
+            # have a consumer (the step loop polls for armed windows)
+            agg.enable_profile()
         self._sync_schedule_gauge()
         # scalar event stream (reference's tensorboardX seam, live):
         # process 0 only, like the reference's rank-gated writer. With
@@ -743,6 +750,242 @@ class Trainer:
                 "telemetry: trace attributed %d group comm time(s)",
                 len(measured),
             )
+
+    # ------------------------------------------------------------------
+    # On-demand deep profiling (ISSUE 10): /profile?steps=N arms a
+    # bounded jax.profiler.trace window on the LIVE job. The HTTP handler
+    # only flips host state (telemetry/serve.MetricsAggregator); the step
+    # loop consumes it here. The window itself deliberately SYNCS the
+    # device (like the startup MGWFBP_TELEMETRY_TRACE snapshot and the
+    # autotune race) — it runs on demand only; the DISARMED check is one
+    # lock acquire, so the step loop's zero-sync contract holds whenever
+    # no window is armed (pinned by the zero-sync guard test).
+    # ------------------------------------------------------------------
+
+    def _maybe_profile_window(self) -> None:
+        """Consume an armed /profile request at a step boundary.
+
+        Single-process: checked every step (the "next N steps" promise).
+        Multi-host: the window's steps are lockstep collective steps, so
+        EVERY process must enter it together — at every agree-interval
+        step the group gathers its locally-armed step counts (the gate
+        reads only group-uniform config, so agreement participation never
+        depends on the local request) and runs the agreed max. Each
+        process traces locally; the per-group device times are then
+        gathered so any process's /profile answer shows the whole
+        group."""
+        if self.config.metrics_port is None:
+            return
+        agg = getattr(self, "_metrics_agg", None)
+        if coord.process_count() == 1:
+            req = agg.take_profile_request() if agg is not None else None
+            if req:
+                self._run_profile_window(int(req))
+            return
+        if self.iteration % self._agree_interval != 0:
+            return
+        local = float(
+            agg.take_profile_request() or 0
+        ) if agg is not None else 0.0
+        steps = int(max(coord.gather_values(local)))
+        if steps > 0:
+            self._run_profile_window(steps)
+
+    def _live_step_hlo_text(self, sample_batch) -> Optional[str]:
+        """COMPILED (post-optimization) HLO text of the live jitted step.
+
+        The /profile attribution join key: backends that drop the jax
+        name stack from trace-event metadata (the CPU mesh) name each
+        event after the HLO instruction it ran, and the compiled module's
+        per-instruction op_name metadata still carries the
+        mgwfbp_groupNNNN scope (profiling.hlo_collective_scope_map).
+        Cached per step-program build; lowering never consumes donated
+        buffers."""
+        if self._step_hlo_cache is not None:
+            return self._step_hlo_cache
+        try:
+            args = [self.state, sample_batch]
+            if self.meta.has_carry:
+                if self.carry is None:
+                    self.carry = self._globalize(
+                        self.model.initial_carry(self.process_batch), axes=0
+                    )
+                args.append(self.carry)
+            self._step_hlo_cache = (
+                self.train_step.lower(*args).compile().as_text()
+            )
+        except Exception as e:  # noqa: BLE001 — the join is an
+            # attribution upgrade; without it the window still writes the
+            # trace slice
+            self.log.info("profile: live-step HLO unavailable (%s)", e)
+        return self._step_hlo_cache
+
+    def _run_profile_window(self, steps: int) -> None:
+        """Trace `steps` live training steps (state carried — genuine
+        optimizer steps, nothing replayed or lost), write the Chrome-trace
+        slice next to the run's logs, attribute per-merge-group device
+        time, gather it across processes, and feed the drift detector's
+        ABSOLUTE per-group residual channel — a straggler whose slowness
+        is purely device-side becomes visible live, not only post-hoc."""
+        from mgwfbp_tpu.telemetry.serve import PROFILE_MAX_STEPS
+
+        steps = max(1, min(int(steps), PROFILE_MAX_STEPS))
+        agg = getattr(self, "_metrics_agg", None)
+        num_groups = (
+            self.reducer.layout.num_groups
+            if self.reducer is not None else 0
+        )
+        trace_dir = None
+        if self.config.logdir:
+            trace_dir = os.path.join(
+                self.config.logdir, self.config.tag(), "profile",
+                f"iter{self.iteration:08d}",
+            )
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+            except OSError as e:
+                # a full/read-only logdir must degrade (temp-dir trace,
+                # discarded after attribution), never kill the run
+                self.log.warning(
+                    "profile: cannot create %s (%s); trace slice will "
+                    "not be persisted", trace_dir, e,
+                )
+                trace_dir = None
+        self.log.info(
+            "profile window: tracing %d live step(s) at iter %d%s",
+            steps, self.iteration,
+            f" -> {trace_dir}" if trace_dir else "",
+        )
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None:
+            # BEFORE the HLO lower/compile below: the AOT compile of the
+            # live step is itself a legitimately long silent phase
+            from mgwfbp_tpu.utils.watchdog import COMPILE_ALLOW_S
+
+            wd.beat(f"profile window ({steps} steps)",
+                    allow_s=COMPILE_ALLOW_S)
+        import itertools
+
+        batch_iter = self._autotune_batches()
+        sample_batch = next(batch_iter)
+        batch_iter = itertools.chain([sample_batch], batch_iter)
+        hlo_text = (
+            self._live_step_hlo_text(sample_batch) if num_groups else None
+        )
+
+        def run():
+            for _ in range(steps):
+                self.state = self._apply_train_step(
+                    self.state, next(batch_iter)
+                )
+                # count each applied step as it happens: the traced steps
+                # are genuine optimizer steps, and on a failure below the
+                # group-uniform iteration counter (every agree-interval
+                # gate reads it) must still reflect every step that ran
+                self.iteration += 1
+            jax.block_until_ready(self.state)
+
+        t0 = time.perf_counter()
+        try:
+            if num_groups:
+                from mgwfbp_tpu.profiling import trace_group_times
+
+                measured = trace_group_times(
+                    run, num_groups, iters=steps, logdir=trace_dir,
+                    hlo_text=hlo_text,
+                )
+            else:
+                from mgwfbp_tpu.profiling import _with_trace_events
+
+                _with_trace_events(run, logdir=trace_dir)
+                measured = None
+        except Exception as e:  # noqa: BLE001 — observability must never
+            # kill the run it observes
+            self.log.warning("profile window failed (%s)", e)
+            if agg is not None:
+                agg.fail_profile(str(e))
+            return
+        finally:
+            if wd is not None:
+                wd.beat("profile window done")
+        wall_s = time.perf_counter() - t0
+        self._train_step_compiled = True
+        attribution = "trace" if measured is not None else "none"
+        groups_doc: list[dict] = []
+        if self.reducer is not None:
+            layout = self.reducer.layout
+            cost_model = getattr(self, "cost_model", None)
+            predicted = None
+            if cost_model is not None:
+                from mgwfbp_tpu.telemetry import group_comm_times
+
+                predicted, _, _ = group_comm_times(self.reducer, cost_model)
+            for gi in range(num_groups):
+                row = {
+                    "group": gi,
+                    "nbytes": int(layout.group_sizes[gi])
+                    * int(np.dtype(layout.dtypes[gi]).itemsize),
+                }
+                if predicted is not None:
+                    row["predicted_s"] = float(predicted[gi])
+                if measured is not None:
+                    row["device_s"] = float(measured[gi])
+                groups_doc.append(row)
+        # fixed-length gather: attribution is host/backend dependent, so
+        # a process whose trace attributed nothing contributes zeros —
+        # the lockstep shape (num_groups is group-uniform) never varies
+        per_process = None
+        if coord.process_count() > 1 and num_groups:
+            row = (
+                [float(t) for t in measured]
+                if measured is not None and len(measured) == num_groups
+                else [0.0] * num_groups
+            )
+            per_process = coord.gather_vectors(row)
+        if (
+            measured is not None
+            and self.reducer is not None
+            and len(measured) == num_groups
+        ):
+            # the drift detector's comm channel reads these: from the
+            # next log window on it checks each group ABSOLUTELY
+            # (predicted vs device-attributed) instead of the
+            # baseline-relative aggregate — mid-run, no restart
+            self._measured_group_times = [float(t) for t in measured]
+        result = {
+            "steps": int(steps),
+            "iteration": int(self.iteration),
+            "wall_s": float(wall_s),
+            "attribution": attribution,
+            "trace_dir": trace_dir,
+            "groups": groups_doc,
+        }
+        if per_process is not None:
+            result["per_process_device_s"] = {
+                str(pi): [float(t) for t in vec]
+                for pi, vec in enumerate(per_process)
+            }
+        if agg is not None:
+            agg.set_profile_result(result)
+        self._emit_event(
+            "profile", step=int(self.iteration), steps=int(steps),
+            attribution=attribution,
+            device_s=(
+                [float(t) for t in measured] if measured is not None
+                else []
+            ),
+            trace_dir=trace_dir or "",
+        )
+        self.log.info(
+            "profile window done: %d step(s) in %.3g s, attribution=%s"
+            "%s", steps, wall_s, attribution,
+            (
+                " (" + ", ".join(
+                    f"g{r['group']}={r.get('device_s', 0.0):.4g}s"
+                    for r in groups_doc
+                ) + ")"
+            ) if measured is not None else "",
+        )
 
     def _on_watchdog_stall(
         self, phase: str, idle_s: float, timeout_s: float, abort: bool
@@ -2294,9 +2537,12 @@ class Trainer:
             if self._agreed_preempt():
                 self._graceful_drain(epoch, epoch_pos)  # raises Preempted
             # live observability (ISSUE 9): straggler probe + armed drift
-            # re-autotune, both at deterministic (group-uniform) steps
+            # re-autotune, both at deterministic (group-uniform) steps;
+            # ISSUE 10 adds the armed /profile deep-trace window on the
+            # same cadence contract (disarmed = one lock read, zero sync)
             self._maybe_straggler_probe()
             self._maybe_drift_reautotune()
+            self._maybe_profile_window()
             if max_steps is not None and epoch_pos >= max_steps:
                 break
             if self.iteration % log_interval == 0:

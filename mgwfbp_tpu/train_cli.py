@@ -104,8 +104,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="live observability HTTP port (/metrics Prometheus, "
                         "/healthz watchdog-wired liveness, /status run "
-                        "JSON); 0 = ephemeral, multi-host serves "
-                        "port+process_index per process; implies "
+                        "JSON, /profile?steps=N on-demand deep-trace "
+                        "window with per-merge-group device attribution); "
+                        "0 = ephemeral, multi-host serves "
+                        "port+process_index per process (actual bound "
+                        "ports persist via MGWFBP_METRICS_PORT_FILE for "
+                        "the supervisor's /fleet fan-in); implies "
                         "--telemetry (MGWFBP_METRICS_PORT)")
     p.add_argument("--compressor", default=None,
                    choices=["none", "topk"],

@@ -36,6 +36,9 @@ out["bcast"] = coord.broadcast_flag(41.5 if pid == 0 else -3.0)
 # straggler-max = [1.5, 3.0, inf] -> winner 0, everywhere
 idx, reduced = coord.all_argmin([0.5 + pid, 3.0 - pid, None])
 out["argmin"] = [idx, [t if t != float("inf") else "inf" for t in reduced]]
+# per-process VECTORS (the deep-profile device-time fan-in): everyone
+# sees both processes' payloads in process order
+out["gatherv"] = coord.gather_vectors([float(pid), 10.0 + pid])
 coord.barrier("worker_done")
 out["barrier"] = "ok"
 print(json.dumps(out))

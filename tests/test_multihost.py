@@ -67,6 +67,9 @@ def test_coordination_single_process_shortcuts():
     assert coord.agree_any(True) and not coord.agree_any(False)
     assert coord.agree_all(True) and not coord.agree_all(False)
     assert coord.broadcast_flag(3.25) == 3.25
+    assert coord.gather_values(1.5) == [1.5]
+    assert coord.gather_vectors([1.0, 2.0]) == [[1.0, 2.0]]
+    assert coord.gather_vectors([]) == [[]]
     idx, reduced = coord.all_argmin([2.0, 0.5, None])
     assert idx == 1
     assert reduced == [2.0, 0.5, float("inf")]
@@ -99,6 +102,7 @@ def test_coordination_two_process():
         assert r["all"] == [True, False]
         assert r["bcast"] == 41.5  # process 0's value, everywhere
         assert r["argmin"] == [0, [1.5, 3.0, "inf"]]
+        assert r["gatherv"] == [[0.0, 10.0], [1.0, 11.0]]
         assert r["barrier"] == "ok"
 
 

@@ -5,18 +5,20 @@ CPU-mesh run (including the watchdog-stall unhealthy flip), the
 zero-sync pin with the server enabled, rotated multi-segment and
 per-process streams replaying into the aggregator, the registry that
 keeps the file dump and the live endpoint identical, the measured RS/AG
-phase split (calibrate --allgather, profile schema v3), the 2-process
-straggler alarm under a `stall@` fault on proc=1, and the acceptance
-loop: an injected 10x calibration error raises a `drift_alarm` that
-(with MGWFBP_DRIFT_REAUTOTUNE=1) triggers a re-autotune whose committed
-schedule recovers within 5% of the well-calibrated one."""
+phase split (calibrate --allgather, profile schema v3), the SUPERVISED
+2-process straggler alarm under `stall@` faults on proc=1 — now also
+pinning the ISSUE-10 fleet console: /fleet/metrics + /fleet/status
+probed mid-run, the alarm fleet-visible, fleet.json persisting the
+children's actual ephemeral ports — and the acceptance loop: an
+injected 10x calibration error raises a `drift_alarm` that (with
+MGWFBP_DRIFT_REAUTOTUNE=1) triggers a re-autotune whose committed
+schedule recovers within 5% of the well-calibrated one. The fleet/
+profile unit + pinned tests live in tests/test_fleet.py."""
 
 import glob
 import json
 import os
 import socket
-import subprocess
-import sys
 import threading
 import time
 import urllib.error
@@ -478,44 +480,120 @@ def _free_port() -> int:
 
 
 def test_two_process_straggler_alarm(tmp_path):
-    """A 2-process CPU-mesh group with a `stall@` fault on proc=1: the
-    live probe (gathered local busy time per agree interval) must RAISE a
-    straggler alarm naming process 1, identically in BOTH processes'
-    streams, and clear it once the stall passes."""
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update({
-            "JAX_PLATFORMS": "cpu",
-            "MGWFBP_HOST_DEVICES": "4",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            "MGWFBP_COORDINATOR": f"127.0.0.1:{port}",
-            "MGWFBP_NUM_PROCESSES": "2",
-            "MGWFBP_PROCESS_ID": str(pid),
-            "MGWFBP_FAULT_PLAN": "stall@secs=1.5,step=3,proc=1",
-            "MGWFBP_AGREE_INTERVAL": "1",
-            "MGWFBP_STRAGGLER_BAND": "0.5",
-            "MGWFBP_DRIFT_HYSTERESIS": "1",
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "mgwfbp_tpu.train_cli",
-             "--dnn", "lenet", "--synthetic", "--no-profile-backward",
-             "--batch-size", "8", "--num-batches-per-epoch", "6",
-             "--max-epochs", "1", "--epochs", "1", "--seed", "7",
-             "--logdir", str(tmp_path), "--telemetry"],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("2-process straggler run timed out")
-        assert p.returncode == 0, f"rank failed:\n{err[-3000:]}"
+    """A SUPERVISED 2-process CPU-mesh group (ephemeral child metrics
+    ports) with `stall@` faults on proc=1, pinning the fleet console on
+    top of the PR-9 straggler pin (ISSUE 10 acceptance):
+
+      * mid-run, the supervisor's /fleet/metrics merges BOTH children
+        under a `process` label and /fleet/status serves the live
+        straggler table naming both;
+      * the probe-raised straggler alarm is FLEET-VISIBLE (active_alarms
+        naming process 1) while the stalls last;
+      * fleet.json persists both children's ACTUAL bound (ephemeral)
+        ports in Prometheus http_sd format — ports the base+index
+        convention could never have guessed;
+      * post-hoc, the alarm raised naming process 1 identically in BOTH
+        processes' streams and cleared once the stalls passed (the PR-9
+        pin, unchanged)."""
+    import threading
+
+    from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
     from mgwfbp_tpu.telemetry import find_stream_paths
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MGWFBP_HOST_DEVICES": "4",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # three consecutive one-shot stalls keep the alarm ACTIVE long
+        # enough for the fleet poller to observe it live; the clean step
+        # 6 then clears it (hysteresis 1)
+        "MGWFBP_FAULT_PLAN": (
+            "stall@secs=0.8,step=3,proc=1;"
+            "stall@secs=0.8,step=4,proc=1;"
+            "stall@secs=0.8,step=5,proc=1"
+        ),
+        "MGWFBP_AGREE_INTERVAL": "1",
+        "MGWFBP_STRAGGLER_BAND": "0.5",
+        "MGWFBP_DRIFT_HYSTERESIS": "1",
+        "MGWFBP_METRICS_PORT": "0",  # ephemeral: port files must resolve
+    })
+    fleet_port = _free_port()
+    sup = Supervisor(
+        default_train_cmd([
+            "--dnn", "lenet", "--synthetic", "--no-profile-backward",
+            "--batch-size", "8", "--num-batches-per-epoch", "6",
+            "--max-epochs", "1", "--epochs", "1", "--seed", "7",
+            "--logdir", str(tmp_path), "--telemetry",
+        ]),
+        2,
+        env=env,
+        log_dir=str(tmp_path / "supervisor"),
+        fleet_port=fleet_port,
+    )
+    rc_box: dict = {}
+    runner = threading.Thread(
+        target=lambda: rc_box.update(rc=sup.run()), daemon=True
+    )
+    runner.start()
+
+    def probe(path):
+        # the fan-in binds a beat after sup.run() starts; refused
+        # connections during that race are "not yet", not failures
+        try:
+            return _get(fleet_port, path)
+        except Exception as e:  # noqa: BLE001 — poll until deadline
+            return None, str(e)
+
+    fleet_table = None
+    fleet_metrics = None
+    fleet_alarm = None
+    deadline = time.monotonic() + 290
+    while runner.is_alive() and time.monotonic() < deadline and not (
+        fleet_table and fleet_metrics and fleet_alarm
+    ):
+        code, body = probe("/fleet/status")
+        if code == 200:
+            doc = json.loads(body)
+            named = {
+                r["process"] for r in doc.get("straggler_table", [])
+            }
+            if fleet_table is None and named == {0, 1}:
+                fleet_table = doc["straggler_table"]
+            for a in doc.get("active_alarms", []):
+                if a.get("alarm") == "straggler":
+                    fleet_alarm = a
+        if fleet_metrics is None:
+            code, body = probe("/fleet/metrics")
+            if code == 200 and all(
+                f'mgwfbp_current_step{{process="{i}"}}' in body
+                for i in range(2)
+            ):
+                fleet_metrics = body
+        time.sleep(0.05)
+    runner.join(timeout=300)
+    if runner.is_alive():
+        pytest.fail("supervised 2-process straggler run timed out")
+    assert rc_box.get("rc") == 0, rc_box
+    assert fleet_table is not None, (
+        "/fleet/status never served a straggler table naming both "
+        "processes"
+    )
+    assert fleet_metrics is not None, (
+        "/fleet/metrics never merged both children under the process "
+        "label"
+    )
+    assert fleet_alarm is not None, (
+        "the straggler alarm never became fleet-visible in "
+        "/fleet/status active_alarms"
+    )
+    assert fleet_alarm["slow_process"] == 1, fleet_alarm
+    assert fleet_alarm["excess_s"] > 0.5, fleet_alarm
+    # fleet.json: the children's ACTUAL ephemeral endpoints, http_sd form
+    sd = json.load(open(str(tmp_path / "supervisor" / "fleet.json")))
+    assert {g["labels"]["process"] for g in sd} == {"0", "1"}
+    ports = [int(g["targets"][0].rsplit(":", 1)[1]) for g in sd]
+    assert all(p > 0 for p in ports) and len(set(ports)) == 2, sd
 
     run_dirs = [
         d for d in glob.glob(str(tmp_path / "*"))

@@ -23,9 +23,9 @@ JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
 echo "== [4/6] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py || rc=1
 
-echo "== [5/6] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume) =="
-# hard timeout: a coordination bug's failure mode is a distributed HANG,
-# which must fail the gate, not wedge it
+echo "== [5/6] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
+# hard timeout: a coordination bug's failure mode is a distributed HANG —
+# and so is a fleet fan-in bug's — which must fail the gate, not wedge it
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --processes 2 || rc=1
 
 echo "== [6/6] tier-1 tests =="

@@ -28,6 +28,13 @@ serve the live step counter, /healthz must answer 200), and the
 2-process group must serve DISTINCT ports (base + process_index), each
 reporting its own process_index in /status.
 
+The 2-process mode additionally smokes the FLEET fan-in (ISSUE 10): the
+supervisor's /fleet/status must answer MID-RUN with a live straggler
+table naming BOTH processes (a fan-in hang fails check.sh's hard-timeout
+stage, exactly like a coordination hang), /fleet/metrics must merge both
+children under a `process` label, and the `fleet.json` http_sd sidecar
+must persist both children's ACTUAL metrics endpoints.
+
 Asserts the telemetry lifecycle after each run. No accelerator, dataset,
 or network needed.
 """
@@ -194,12 +201,14 @@ def multi_process(processes: int) -> dict:
         # base + i (telemetry/serve.resolve_metrics_port)
         base_port = _free_port()
         env["MGWFBP_METRICS_PORT"] = str(base_port)
+        fleet_port = _free_port()
         sup = Supervisor(
             default_train_cmd(_cli(d)[3:]),  # strip interpreter/-m/module
             processes,
             backoff_base_s=0.2,
             log_dir=os.path.join(d, "supervisor"),
             env=env,
+            fleet_port=fleet_port,
         )
         import threading
 
@@ -209,10 +218,18 @@ def multi_process(processes: int) -> dict:
         )
         runner.start()
         # mid-run: every process of the group serves a DISTINCT port,
-        # each reporting its own process_index in /status
+        # each reporting its own process_index in /status; the
+        # supervisor's FLEET fan-in must answer too, with a live
+        # straggler table naming BOTH processes (hard-deadline bounded —
+        # a fan-in hang must fail this stage, never wedge it)
         served: dict = {}
+        fleet_doc = None
+        fleet_metrics = None
         deadline = time.monotonic() + 590
-        while runner.is_alive() and len(served) < processes:
+        while runner.is_alive() and (
+            len(served) < processes or fleet_doc is None
+            or fleet_metrics is None
+        ):
             if time.monotonic() > deadline:
                 break
             for i in range(processes):
@@ -221,6 +238,27 @@ def multi_process(processes: int) -> dict:
                 code, body = _probe(base_port + i, "/status")
                 if code == 200:
                     served[i] = json.loads(body)
+            if fleet_doc is None:
+                code, body = _probe(
+                    fleet_port, "/fleet/status", timeout_s=10.0
+                )
+                if code == 200:
+                    doc = json.loads(body)
+                    named = {
+                        r["process"]
+                        for r in doc.get("straggler_table", [])
+                    }
+                    if named == set(range(processes)):
+                        fleet_doc = doc
+            if fleet_metrics is None:
+                code, body = _probe(
+                    fleet_port, "/fleet/metrics", timeout_s=10.0
+                )
+                if code == 200 and all(
+                    f'mgwfbp_current_step{{process="{i}"}}' in body
+                    for i in range(processes)
+                ):
+                    fleet_metrics = body
             time.sleep(0.1)
         runner.join(timeout=600)
         assert not runner.is_alive(), "supervised group wedged"
@@ -232,6 +270,22 @@ def multi_process(processes: int) -> dict:
         )
         for i, st in served.items():
             assert st["run"]["process_index"] == i, (i, st["run"])
+        assert fleet_doc is not None, (
+            "/fleet/status never served a live straggler table naming "
+            f"every process (fleet port {fleet_port})"
+        )
+        assert fleet_doc["reachable"] == processes, fleet_doc
+        assert fleet_metrics is not None, (
+            "/fleet/metrics never merged every child under the process "
+            "label"
+        )
+        # the http_sd sidecar persists the children's ACTUAL endpoints
+        fleet_sd_path = os.path.join(d, "supervisor", "fleet.json")
+        assert os.path.exists(fleet_sd_path), fleet_sd_path
+        with open(fleet_sd_path) as f:
+            sd = json.load(f)
+        sd_procs = {g["labels"]["process"] for g in sd}
+        assert sd_procs == {str(i) for i in range(processes)}, sd
         assert len(sup.results) == 2, (
             f"expected preempt + 1 resubmission, got "
             f"{[r.returncodes for r in sup.results]}"
@@ -276,6 +330,10 @@ def multi_process(processes: int) -> dict:
             "merged_records": len(merged),
             "preempt_signals": signals,
             "metrics_ports": [base_port + i for i in range(processes)],
+            "fleet_straggler_table": fleet_doc["straggler_table"],
+            "fleet_sd_targets": sorted(
+                t for g in sd for t in g["targets"]
+            ),
         }
 
 

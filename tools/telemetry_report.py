@@ -24,7 +24,13 @@ Optionally renders the same stream for external viewers:
   python tools/telemetry_report.py <run>/telemetry.jsonl
   python tools/telemetry_report.py <run>/telemetry.jsonl \
       --chrome-trace trace.json --prometheus metrics.prom
+  python tools/telemetry_report.py --live http://host:port   # RUNNING job
   python tools/telemetry_report.py --selftest   # synthetic stream smoke
+
+``--live`` renders the overlap/alarms/lifecycle view from a RUNNING
+job's /status + /metrics endpoints (telemetry/serve.py) instead of JSONL
+files; pointed at a supervisor's fleet fan-in it renders the group view
+(/fleet/status: per-process table, live stragglers, fleet alarms).
 
 ``--selftest`` exercises the full pipeline (writer -> reader -> report ->
 Chrome trace -> Prometheus) on a synthetic stream in a temp dir — the
@@ -209,6 +215,199 @@ def format_report(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def _alarm_lines(alarms: list[dict]) -> list[str]:
+    """Active-alarm table rows (live /status and /fleet/status share the
+    same alarm dicts the aggregator keeps)."""
+    lines = [
+        f"  {'kind':>14} {'group/proc':>10} {'residual':>10} {'band':>8}"
+    ]
+    for a in alarms:
+        if a.get("alarm") == "straggler" or "slow_process" in a:
+            kind = "straggler"
+            who = f"p{a.get('slow_process')}"
+            residual = _fmt_s(a.get("excess_s"))
+            band = "-"
+        else:
+            kind = str(a.get("kind"))
+            who = (
+                str(a.get("group"))
+                if int(a.get("group", -1)) >= 0 else "agg"
+            )
+            residual = _fmt_s(a.get("residual"))
+            band = _fmt_s(a.get("band"))
+        procs = a.get("processes")
+        lines.append(
+            f"  {kind:>14} {who:>10} {residual:>10} {band:>8}"
+            + (f"  reported by {sorted(procs)}" if procs else "")
+        )
+    return lines
+
+
+def format_live_report(status: dict, values: dict) -> str:
+    """One process's live view, from its /status JSON + parsed /metrics
+    (same sections as the post-hoc report, sourced from the running
+    job)."""
+    lines: list[str] = []
+    run = status.get("run", {}) or {}
+    desc = ", ".join(f"{k}={v}" for k, v in sorted(run.items()))
+    lines.append(f"live /status ({desc})" if desc else "live /status")
+    lines.append(
+        f"health: {'ok' if status.get('healthy') else 'UNHEALTHY'}"
+        + (
+            f" — {status.get('health_reason')}"
+            if not status.get("healthy") else ""
+        )
+        + f" (uptime {_fmt_s(status.get('uptime_s'))} s)"
+    )
+    lines.append("")
+    lines.append(
+        f"steps: {values.get('mgwfbp_steps_total', 0)} recorded, at step "
+        f"{status.get('step')} epoch {status.get('epoch')}, mean "
+        f"{_fmt_s(values.get('mgwfbp_step_seconds'))} s/step "
+        "(rolling window)"
+    )
+    sched = status.get("schedule")
+    if sched:
+        lines.append(
+            f"schedule: {sched.get('comm_op')} x "
+            f"{sched.get('num_groups')} group(s) "
+            f"({sched.get('policy_detail')})"
+        )
+    eff = status.get("overlap_efficiency")
+    if eff is not None:
+        lines.append(
+            f"overlap efficiency: {float(eff):.4f} (hidden "
+            f"{_fmt_s(values.get('mgwfbp_comm_hidden_seconds'))} s + "
+            f"exposed {_fmt_s(values.get('mgwfbp_comm_exposed_seconds'))}"
+            " s per step)"
+        )
+    alarms = status.get("active_alarms") or []
+    lines.append("")
+    if alarms:
+        lines.append(f"active alarms ({len(alarms)}):")
+        lines.extend(_alarm_lines(alarms))
+    else:
+        lines.append("active alarms: none")
+    lines.append("")
+    lines.append("lifecycle counters:")
+    for key, label in (
+        ("mgwfbp_checkpoints_total", "checkpoints"),
+        ("mgwfbp_resizes_total", "resizes"),
+        ("mgwfbp_bad_steps_total", "bad steps"),
+        ("mgwfbp_rollbacks_total", "rollbacks"),
+        ("mgwfbp_preempts_total", "preempts"),
+        ("mgwfbp_resumes_total", "resumes"),
+        ("mgwfbp_watchdog_stalls_total", "watchdog stalls"),
+        ("mgwfbp_autotune_commits_total", "autotune commits"),
+        ("mgwfbp_drift_alarms_total", "drift alarms"),
+        ("mgwfbp_straggler_alarms_total", "straggler alarms"),
+        ("mgwfbp_profile_windows_total", "profile windows"),
+    ):
+        v = values.get(key, 0)
+        if v:
+            lines.append(f"  {label}: {v}")
+    prof = status.get("profile") or {}
+    if prof.get("state") not in (None, "idle"):
+        lines.append("")
+        lines.append(f"profile window: {prof.get('state')}")
+        res = prof.get("result")
+        if res:
+            lines.append(
+                f"  {res.get('steps')} step(s), attribution="
+                f"{res.get('attribution')}"
+                + (
+                    ", " + ", ".join(
+                        f"g{g['group']}={_fmt_s(g.get('device_s'))}s"
+                        for g in res.get("groups", [])
+                        if "device_s" in g
+                    ) if res.get("groups") else ""
+                )
+            )
+    return "\n".join(lines)
+
+
+def format_fleet_report(doc: dict) -> str:
+    """The supervisor fan-in's group view (/fleet/status)."""
+    lines = [
+        f"fleet /fleet/status: {doc.get('reachable', 0)} process(es) "
+        f"reachable, {len(doc.get('unreachable') or [])} unreachable, "
+        f"{'healthy' if doc.get('healthy') else 'UNHEALTHY'}"
+    ]
+    table = doc.get("straggler_table") or []
+    if table:
+        lines.append("")
+        lines.append("live straggler table (mean-excess vs fastest):")
+        lines.append(
+            f"  {'proc':>5} {'step':>8} {'mean_step_s':>12} "
+            f"{'excess_s':>10} {'excess_%':>9}"
+        )
+        for r in table:
+            lines.append(
+                f"  {r['process']:>5} {str(r.get('step', '-')):>8} "
+                f"{_fmt_s(r['mean_step_s']):>12} "
+                f"{_fmt_s(r.get('excess_s')):>10} "
+                f"{r.get('excess_pct', 0.0):>8.1f}%"
+            )
+    slow = doc.get("slowest_process")
+    if slow:
+        lines.append(
+            f"slowest: process {slow['process']} "
+            f"(+{_fmt_s(slow['excess_s'])} s/step, "
+            f"+{slow['excess_pct']:.1f}%)"
+        )
+    alarms = doc.get("active_alarms") or []
+    lines.append("")
+    if alarms:
+        lines.append(f"fleet active alarms ({len(alarms)}):")
+        lines.extend(_alarm_lines(alarms))
+    else:
+        lines.append("fleet active alarms: none")
+    for u in doc.get("unreachable") or []:
+        lines.append(
+            f"UNREACHABLE: p{u.get('process')} at {u.get('target')} "
+            f"({u.get('error')})"
+        )
+    return "\n".join(lines)
+
+
+def _fetch(url: str, timeout_s: float = 5.0):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.status, resp.read().decode()
+    except Exception as e:  # noqa: BLE001 — refused/timeout: try the
+        # other endpoint family, then report
+        return None, str(e)
+
+
+def live_report(base: str) -> int:
+    """Render from a RUNNING job: per-process /status + /metrics, or a
+    supervisor fan-in's /fleet/status."""
+    from mgwfbp_tpu.telemetry.export import parse_metrics_text
+
+    base = base.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+    code, body = _fetch(base + "/status")
+    if code == 200:
+        status = json.loads(body)
+        mcode, mtext = _fetch(base + "/metrics")
+        values = parse_metrics_text(mtext) if mcode == 200 else {}
+        print(format_live_report(status, values))
+        return 0
+    fcode, fbody = _fetch(base + "/fleet/status")
+    if fcode == 200:
+        print(format_fleet_report(json.loads(fbody)))
+        return 0
+    print(
+        f"telemetry_report: no live endpoint at {base} "
+        f"(/status: {code or body}; /fleet/status: {fcode or fbody})",
+        file=sys.stderr,
+    )
+    return 2
+
+
 def _synthetic_stream(path: str) -> None:
     """Write a small but complete stream: header, steps, an overlap
     snapshot with a known hidden/exposed split, and lifecycle events."""
@@ -282,6 +481,49 @@ def selftest() -> int:
         agg.replay(records)
         assert render_metrics(agg.values()) == prom
         assert "mgwfbp_drift_alarms_total 1" in prom, prom
+        # --live round trip: serve the replayed aggregator over HTTP and
+        # render the live report from /status + /metrics; then fan two
+        # such children into a fleet view (ISSUE 10) and render that
+        from mgwfbp_tpu.telemetry.export import parse_metrics_text
+        from mgwfbp_tpu.telemetry.fleet import FleetServer, scrape_fleet
+        from mgwfbp_tpu.telemetry.serve import TelemetryServer
+
+        srv = TelemetryServer(agg, 0, host="127.0.0.1")
+        fleet = FleetServer(
+            lambda: {0: ("127.0.0.1", srv.port),
+                     1: ("127.0.0.1", srv.port)},
+            port=0,
+        )
+        try:
+            code, body = _fetch(f"http://127.0.0.1:{srv.port}/status")
+            assert code == 200, body
+            status = json.loads(body)
+            code, mtext = _fetch(f"http://127.0.0.1:{srv.port}/metrics")
+            assert code == 200 and parse_metrics_text(mtext), mtext
+            live = format_live_report(status, parse_metrics_text(mtext))
+            assert "steps: 24 recorded" in live, live
+            children = scrape_fleet(
+                {0: ("127.0.0.1", srv.port), 1: ("127.0.0.1", srv.port)}
+            )
+            assert all(c.reachable for c in children)
+            code, fbody = _fetch(
+                f"http://127.0.0.1:{fleet.port}/fleet/status"
+            )
+            assert code == 200, fbody
+            fdoc = json.loads(fbody)
+            assert {r["process"] for r in fdoc["straggler_table"]} == {
+                0, 1,
+            }, fdoc
+            code, fmet = _fetch(
+                f"http://127.0.0.1:{fleet.port}/fleet/metrics"
+            )
+            assert 'mgwfbp_steps_total{process="0"} 24' in fmet, fmet
+            assert 'mgwfbp_steps_total{process="1"} 24' in fmet, fmet
+            print(format_fleet_report(fdoc))
+            print()
+        finally:
+            fleet.close()
+            srv.close()
         print(report)
         print()
         print(
@@ -304,14 +546,21 @@ def main(argv=None) -> int:
                    help="write a chrome://tracing / Perfetto JSON here")
     p.add_argument("--prometheus", default=None,
                    help="write a Prometheus text-exposition dump here")
+    p.add_argument("--live", default=None, metavar="URL",
+                   help="render from a RUNNING job's /status + /metrics "
+                        "(or a supervisor fan-in's /fleet/status) "
+                        "instead of JSONL files, e.g. "
+                        "http://127.0.0.1:9100")
     p.add_argument("--selftest", action="store_true",
                    help="run the synthetic end-to-end smoke and exit")
     args = p.parse_args(argv)
 
     if args.selftest:
         return selftest()
+    if args.live:
+        return live_report(args.live)
     if not args.events:
-        p.error("events path required (or --selftest)")
+        p.error("events path required (or --selftest, or --live URL)")
     path = args.events
     if os.path.isdir(path):
         path = os.path.join(path, "telemetry.jsonl")
