@@ -39,13 +39,16 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--comm-ops", dest="comm_ops",
-        default="all_reduce,rs_opt_ag,rs_fwd_ag",
+        default="all_reduce,rs_opt_ag,rs_fwd_ag,hier",
         help="comma-separated bucket lowerings to verify; each policy is "
         "traced under each (rs_opt_ag/rs_fwd_ag are verified with "
         "global-norm clipping on, so the cross-group clip psum is covered "
         "too; rs_fwd_ag traces TWO consecutive steps — the cross-step "
         "contract: each group's reduce-scatter in step N, its all-gather "
-        "in step N+1's forward)",
+        "in step N+1's forward; hier traces on an (ici, dcn) virtual mesh "
+        "under a slow-DCN two-level cost model — the SCH009 nested "
+        "contract: per-group inner RS/AG plus one outer collective per "
+        "DCN group, no stray cross-pod collectives)",
     )
     parser.add_argument("--warnings-as-errors", action="store_true",
                         help="exit non-zero on warnings too")

@@ -29,7 +29,15 @@ asserts, against the `MergedAllreduce` that built it:
           reduction feeding the metrics psum (its count rides the EXISTING
           metrics_reduce collective — the guard adds no collective of its
           own, which SCH001/SCH004 already pin), and a guard-disabled step
-          must not.
+          must not;
+  SCH009  the hierarchical (comm_op='hier') contract: per inner group one
+          reduce-scatter then one all-gather over the INNER (ICI) axis
+          only, per DCN group exactly one OUTER-axis collective under its
+          ``mgwfbp_dcngroupNNNN`` scope moving exactly its members'
+          concatenated shards at the wire dtype, the DCN partition
+          covering every inner group exactly once, no cross-pod (outer-
+          axis) collective anywhere else, and the DCN scope never
+          appearing on a non-hier path.
 """
 
 from __future__ import annotations
@@ -75,6 +83,31 @@ def _group_scope_re() -> "re.Pattern[str]":
     from mgwfbp_tpu.parallel.allreduce import GROUP_SCOPE_PREFIX
 
     return re.compile(re.escape(GROUP_SCOPE_PREFIX) + r"(\d+)")
+
+
+def _dcn_scope_re() -> "re.Pattern[str]":
+    """Regex for the hier lowering's DCN-group scope
+    (`parallel.allreduce.DCN_GROUP_SCOPE_PREFIX`)."""
+    from mgwfbp_tpu.parallel.allreduce import DCN_GROUP_SCOPE_PREFIX
+
+    return re.compile(re.escape(DCN_GROUP_SCOPE_PREFIX) + r"(\d+)")
+
+
+def _eqn_axes(eqn: Any) -> tuple:
+    """Named mesh axes a collective eqn reduces/gathers over (psum and
+    psum_scatter carry `axes`, all_gather `axis_name`); empty when the
+    param shape is unrecognized."""
+    for key in ("axes", "axis_name"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, str):
+            return (v,)
+        try:
+            return tuple(a for a in v if isinstance(a, str))
+        except TypeError:
+            return ()
+    return ()
 
 
 def _scope_segments(scope: str) -> list[str]:
@@ -131,13 +164,17 @@ def _numel(aval: Any) -> int:
 def collect_collectives(closed_jaxpr: Any) -> dict[str, list]:
     """Classify every collective/callback eqn in the traced program.
 
-    Returns {"groups": {gi: [eqn, ...]}, "allowed": [...], "stray": [...],
-    "callbacks": [...]} where group membership comes from the
-    `mgwfbp_groupNNNN` name scope stamped by `parallel.allreduce`.
+    Returns {"groups": {gi: [eqn, ...]}, "dcn_groups": {di: [eqn, ...]},
+    "allowed": [...], "stray": [...], "callbacks": [...]} where group
+    membership comes from the `mgwfbp_groupNNNN` (and, for the hier
+    lowering's outer collectives, `mgwfbp_dcngroupNNNN`) name scopes
+    stamped by `parallel.allreduce`.
     """
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     group_re = _group_scope_re()
+    dcn_re = _dcn_scope_re()
     groups: dict[int, list] = {}
+    dcn_groups: dict[int, list] = {}
     allowed: list = []
     stray: list = []
     callbacks: list = []
@@ -149,8 +186,11 @@ def collect_collectives(closed_jaxpr: Any) -> dict[str, list]:
         if name not in COLLECTIVE_PRIMS:
             continue
         scope = _scope_of(eqn)
+        dm = dcn_re.search(scope)
         m = group_re.search(scope)
-        if m is not None:
+        if dm is not None:
+            dcn_groups.setdefault(int(dm.group(1)), []).append(eqn)
+        elif m is not None:
             groups.setdefault(int(m.group(1)), []).append(eqn)
         elif any(
             seg in DEFAULT_ALLOWED_SCOPES for seg in _scope_segments(scope)
@@ -159,8 +199,8 @@ def collect_collectives(closed_jaxpr: Any) -> dict[str, list]:
         else:
             stray.append(eqn)
     return {
-        "groups": groups, "allowed": allowed, "stray": stray,
-        "callbacks": callbacks,
+        "groups": groups, "dcn_groups": dcn_groups, "allowed": allowed,
+        "stray": stray, "callbacks": callbacks,
     }
 
 
@@ -285,6 +325,145 @@ def _check_rs_fwd_ag_group(reducer: Any, gi: int, eqns: list, add) -> None:
             f"{np.dtype(layout.dtypes[gi]).name}")
 
 
+def _check_hier_group(
+    reducer: Any, gi: int, eqns: list, add
+) -> Optional[int]:
+    """The hier per-inner-group collective contract: exactly ONE
+    reduce-scatter (the padded grad bucket at the wire dtype) followed by
+    ONE all-gather (the slice shard, post-DCN) under the group's scope —
+    both over the INNER (ICI) axis only. A cross-pod (outer-axis)
+    collective inside a group scope means the lowering silently routed
+    bucket traffic over the slow link the schedule never priced; AG
+    before RS means the leg order degenerated. Returns the group's shard
+    element count (the DCN contract's payload unit), or None when the
+    shape is too broken to measure."""
+    layout = reducer.layout
+    comm_dtype = getattr(reducer, "comm_dtype", None)
+    inner = reducer.axis_name[0]
+    outer = reducer.axis_name[1] if len(reducer.axis_name) > 1 else None
+    for e in eqns:
+        axes = _eqn_axes(e)
+        if outer is not None and outer in axes:
+            add("SCH009",
+                f"hier group {gi}: '{e.primitive.name}' over the OUTER "
+                f"(DCN) axis {outer!r} inside an inner-group scope — "
+                "cross-pod traffic belongs under mgwfbp_dcngroupNNNN")
+    reductions = [e for e in eqns if e.primitive.name in REDUCTION_PRIMS]
+    gathers = [e for e in eqns if e.primitive.name == "all_gather"]
+    extra = [e for e in eqns if e not in reductions and e not in gathers]
+    if len(reductions) != 1 or len(gathers) != 1:
+        add("SCH001",
+            f"hier group {gi}: expected exactly 1 reduce-scatter + 1 "
+            f"all-gather under its scope, found {len(reductions)} "
+            f"reduction(s) + {len(gathers)} gather(s)")
+        return None
+    for e in extra:
+        add("SCH004",
+            f"hier group {gi}: unexpected '{e.primitive.name}' in the "
+            "group scope")
+    rs, ag = reductions[0], gathers[0]
+    if eqns.index(ag) < eqns.index(rs):
+        add("SCH009",
+            f"hier group {gi}: the all-gather precedes the reduce-scatter "
+            "in program order — the inner RS -> outer AR -> inner AG leg "
+            "order degenerated")
+    for e, leg in ((rs, "reduce-scatter"), (ag, "all-gather")):
+        axes = _eqn_axes(e)
+        if axes and tuple(axes) != (inner,):
+            add("SCH009",
+                f"hier group {gi}: {leg} runs over axes {axes}, the inner "
+                f"leg must ride {inner!r} only")
+    want_elems = layout.group_sizes[gi]
+    rs_elems = _numel(rs.invars[0].aval)
+    if rs_elems < want_elems:
+        add("SCH007",
+            f"hier group {gi}: reduce-scatter moves {rs_elems} elements, "
+            f"layout says >= {want_elems}")
+    shard_elems = _numel(rs.outvars[0].aval)
+    ag_elems = _numel(ag.invars[0].aval)
+    if ag_elems != shard_elems:
+        add("SCH007",
+            f"hier group {gi}: all-gather operand is {ag_elems} elements, "
+            f"the inner shard is {shard_elems}")
+    want_wire = comm_dtype if comm_dtype is not None else layout.dtypes[gi]
+    for e, leg in ((rs, "reduce-scatter"), (ag, "all-gather")):
+        if np.dtype(e.invars[0].aval.dtype) != np.dtype(want_wire):
+            add("SCH002",
+                f"hier group {gi}: {leg} runs at dtype "
+                f"{np.dtype(e.invars[0].aval.dtype).name}, wire dtype is "
+                f"{np.dtype(want_wire).name}")
+    return shard_elems
+
+
+def _check_hier_dcn(
+    reducer: Any, info: dict, shard_elems: dict, add
+) -> None:
+    """The hier DCN contract (SCH009): the nested partition covers every
+    inner group exactly once, each DCN group issues exactly ONE psum over
+    the OUTER axis moving exactly its members' concatenated shards at the
+    wire dtype — no more DCN collectives than the schedule promised
+    (merging on DCN exists to amortize the slow link's startup; a split
+    the verifier misses silently doubles it)."""
+    from mgwfbp_tpu.parallel.solver import check_dcn_partition
+
+    layout = reducer.layout
+    schedule = reducer.schedule
+    comm_dtype = getattr(reducer, "comm_dtype", None)
+    inner = reducer.axis_name[0]
+    outer = reducer.axis_name[1] if len(reducer.axis_name) > 1 else None
+    dcn_part = [list(d) for d in schedule.dcn_groups] or [
+        [gi] for gi in range(layout.num_groups)
+    ]
+    try:
+        check_dcn_partition(dcn_part, layout.num_groups)
+    except ValueError as e:
+        add("SCH009", f"hier: {e}")
+        return
+    observed = info["dcn_groups"]
+    if sorted(observed) != list(range(len(dcn_part))):
+        add("SCH009",
+            f"hier: traced step issues DCN collectives for scopes "
+            f"{sorted(observed)}, the nested schedule promises "
+            f"{len(dcn_part)} DCN group(s)")
+        return
+    for di, members in enumerate(dcn_part):
+        eqns = observed[di]
+        if len(eqns) != 1 or eqns[0].primitive.name != "psum":
+            add("SCH009",
+                f"hier dcn group {di}: expected exactly 1 outer-axis psum "
+                f"under its scope, found "
+                f"{[e.primitive.name for e in eqns]}")
+            continue
+        eqn = eqns[0]
+        axes = _eqn_axes(eqn)
+        if axes and (
+            (outer is not None and tuple(axes) != (outer,))
+            or inner in axes
+        ):
+            add("SCH009",
+                f"hier dcn group {di}: psum runs over axes {axes}, the "
+                f"cross-slice leg must ride {outer!r} only")
+        want = sum(
+            shard_elems.get(gi) or 0 for gi in members
+        )
+        got = _numel(eqn.invars[0].aval)
+        if all(shard_elems.get(gi) for gi in members) and got != want:
+            add("SCH009",
+                f"hier dcn group {di}: outer collective moves {got} "
+                f"elements, members {members} shard to {want}")
+        dtypes = {layout.dtypes[gi] for gi in members}
+        want_wire = comm_dtype if comm_dtype is not None else (
+            next(iter(dtypes)) if len(dtypes) == 1 else None
+        )
+        if want_wire is not None and (
+            np.dtype(eqn.invars[0].aval.dtype) != np.dtype(want_wire)
+        ):
+            add("SCH009",
+                f"hier dcn group {di}: outer collective runs at dtype "
+                f"{np.dtype(eqn.invars[0].aval.dtype).name}, wire dtype "
+                f"is {np.dtype(want_wire).name}")
+
+
 def verify_jaxpr_against_reducer(
     closed_jaxpr: Any,
     reducer: Any,
@@ -334,6 +513,7 @@ def verify_jaxpr_against_reducer(
     # no static payload expectation exists and the size check is skipped
     padded = comm_op != "all_reduce"
     sparsified = getattr(reducer, "compressor", None) is not None
+    hier_shards: dict[int, Optional[int]] = {}
     for gi in sorted(groups):
         if gi >= layout.num_groups:
             add("SCH001",
@@ -345,6 +525,9 @@ def verify_jaxpr_against_reducer(
             continue
         if comm_op == "rs_fwd_ag":
             _check_rs_fwd_ag_group(reducer, gi, groups[gi], add)
+            continue
+        if comm_op == "hier":
+            hier_shards[gi] = _check_hier_group(reducer, gi, groups[gi], add)
             continue
         eqn = groups[gi][0]  # primary reduction (rs_ag/hier add gathers)
         aval = eqn.invars[0].aval
@@ -366,6 +549,19 @@ def verify_jaxpr_against_reducer(
                 f"{np.dtype(aval.dtype).name}, layout bucket is "
                 f"{np.dtype(want_dtype).name}")
 
+    # the DCN-group scope is the hier lowering's alone: on any other path
+    # a collective hiding under it is scope abuse (SCH009), exactly like
+    # the clip-norm scope below — and on the hier path the full nested
+    # contract applies (count/payload/dtype per DCN group)
+    if comm_op == "hier":
+        _check_hier_dcn(reducer, info, hier_shards, add)
+    else:
+        for di in sorted(info["dcn_groups"]):
+            for eqn in info["dcn_groups"][di]:
+                add("SCH009",
+                    f"'{eqn.primitive.name}' under scope "
+                    f"mgwfbp_dcngroup{di:04d} but comm_op is {comm_op!r} "
+                    "(scope reserved for the hierarchical lowering)")
     for eqn in info["stray"]:
         add("SCH004",
             f"unexpected '{eqn.primitive.name}' outside declared scopes "
@@ -461,6 +657,8 @@ def trace_train_step(
     norm_clip: Optional[float] = None,
     grad_guard: bool = True,
     steps: int = 1,
+    dcn_slices: Optional[int] = None,
+    dcn_groups: Optional[Any] = None,
 ) -> tuple[Any, Any, list]:
     """Build and trace a representative jitted MG-WFBP train step.
 
@@ -480,6 +678,12 @@ def trace_train_step(
     carried state threaded through — one top-level pjit eqn per call,
     which is what `verify_cross_step_jaxpr` splits on (steps=2 is the
     cross-step two-step contract's program).
+
+    comm_op='hier' traces on an (ici, dcn)-shaped virtual mesh
+    (`dcn_slices` outer slices; default 2) under a two-level cost model
+    with a deliberately slow DCN link, so the nested-schedule machinery
+    is exercised, not just the single-link fallback; `dcn_groups`
+    optionally pins an explicit DCN partition (the mutation tests' hook).
     """
     _ensure_cpu_devices()
     import jax
@@ -488,11 +692,24 @@ def trace_train_step(
     from mgwfbp_tpu import models as zoo
     from mgwfbp_tpu.optim import OptimSpec
     from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
-    from mgwfbp_tpu.parallel.costmodel import AlphaBeta
-    from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
+    from mgwfbp_tpu.parallel.costmodel import AlphaBeta, TwoLevelAlphaBeta
+    from mgwfbp_tpu.parallel.mesh import (
+        DATA_AXIS,
+        DCN_AXIS,
+        MeshSpec,
+        make_mesh,
+    )
     from mgwfbp_tpu.train.step import create_train_state, make_train_step
 
-    mesh = make_mesh(MeshSpec(data=len(jax.devices()), seq=1))
+    if comm_op == "hier" and not dcn_slices:
+        dcn_slices = 2
+    dcn = int(dcn_slices or 1)
+    mesh = make_mesh(
+        MeshSpec(data=len(jax.devices()) // dcn, seq=1, dcn=dcn)
+    )
+    axis_name: Any = (
+        (DATA_AXIS, DCN_AXIS) if dcn > 1 else DATA_AXIS
+    )
     model, meta = zoo.create_model(model_name)
     spec = OptimSpec(lr=0.1, kind="sgd", momentum=0.9, norm_clip=norm_clip)
     tx = spec.make_tx()
@@ -505,12 +722,25 @@ def trace_train_step(
     )
     full_params = state.params  # canonical tree (pre any sharded carry)
     kw: dict[str, Any] = {}
-    if policy == "mgwfbp":
-        kw = dict(cost_model=AlphaBeta(1e-4, 1e-9))
+    if policy in ("mgwfbp", "auto"):
+        if comm_op == "hier":
+            # slow-DCN two-level prior: the nested solve must actually
+            # price two links here, or the hier contract only ever sees
+            # the degenerate one-DCN-collective-per-group shape
+            kw = dict(cost_model=TwoLevelAlphaBeta(
+                ici=AlphaBeta(1e-5, 2e-11),
+                dcn=AlphaBeta(2.5e-3, 6e-10),
+                ici_size=len(jax.devices()) // dcn,
+                dcn_size=dcn,
+            ))
+        else:
+            kw = dict(cost_model=AlphaBeta(1e-4, 1e-9))
     if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
         kw.update(optim_spec=spec, world_size=len(jax.devices()))
+    if dcn_groups is not None:
+        kw.update(dcn_groups=dcn_groups)
     reducer = make_merged_allreduce(
-        state.params, axis_name=DATA_AXIS, policy=policy,
+        state.params, axis_name=axis_name, policy=policy,
         comm_dtype=comm_dtype, comm_op=comm_op, **kw,
     )
     if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
@@ -521,7 +751,8 @@ def trace_train_step(
         # params ride as the cross-step sharded carry
         state = state.replace(params=reducer.optim.params_struct())
     step = make_train_step(
-        model, meta, tx, mesh, reducer, donate=donate, grad_guard=grad_guard,
+        model, meta, tx, mesh, reducer, axis_name=axis_name,
+        donate=donate, grad_guard=grad_guard,
     )
     batch = {
         "x": jax.ShapeDtypeStruct(
@@ -664,12 +895,15 @@ def verify_train_step(
     norm_clip: Optional[float] = None,
     grad_guard: bool = True,
     expect_finite_guard: Optional[bool] = None,
+    dcn_slices: Optional[int] = None,
 ) -> list[Finding]:
     """Trace one representative jitted train step and verify it (the
     finite guard is expected exactly as built unless overridden — the
     override exists for the analyzer's own mutation tests). The cross-step
     rs_fwd_ag lowering dispatches to the TWO-step trace: its contract
-    spans a step boundary (RS in step N, AG in step N+1's forward)."""
+    spans a step boundary (RS in step N, AG in step N+1's forward). The
+    hier lowering traces on an (ici, dcn) virtual mesh
+    (`trace_train_step`'s dcn_slices default)."""
     if comm_op == "rs_fwd_ag":
         return verify_cross_step_train_step(
             model_name, policy, comm_dtype=comm_dtype, donate=donate,
@@ -680,7 +914,7 @@ def verify_train_step(
     closed, reducer, arr = trace_train_step(
         model_name, policy, comm_op=comm_op, comm_dtype=comm_dtype,
         donate=donate, batch_size=batch_size, norm_clip=norm_clip,
-        grad_guard=grad_guard,
+        grad_guard=grad_guard, dcn_slices=dcn_slices,
     )
     tag = f"{model_name}/{policy}" + (
         f"/{comm_op}" if comm_op != "all_reduce" else ""

@@ -63,6 +63,23 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "measured_fields from prior_fields per entry.")
     p.add_argument("--prior-world-sizes", default="2,4,8,16",
                    help="extents for the prior-extended entries")
+    p.add_argument("--two-level", dest="two_level", action="store_true",
+                   help="per-AXIS calibration of an (ici x dcn) two-axis "
+                        "mesh (needs --dcn > 1): sweep a pmean over ONLY "
+                        "the inner axis and ONLY the outer axis, fit each "
+                        "link's alpha-beta, and persist a schema-stamped "
+                        "two-level profile (kind='two_level', SampledCost "
+                        "curves per link) — the cost model the two-link "
+                        "hier solver schedules against. Combine with "
+                        "--allgather to also fit the ICI link's RS/AG "
+                        "split. tools/two_level_validation.py consumes "
+                        "this calibration and validates the composition "
+                        "AND the solved hier schedule against measurement.")
+    p.add_argument("--ici", type=int, default=None,
+                   help="inner-axis extent for --two-level (default: "
+                        "devices / dcn)")
+    p.add_argument("--dcn", type=int, default=2,
+                   help="outer-axis extent (slices) for --two-level")
     p.add_argument("--forward", action="store_true",
                    help="LAYER-profile mode (needs --model): benchmark the "
                         "model's per-layer backward AND forward durations "
@@ -85,8 +102,15 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "rest, the latter measures each listed extent")
     if args.forward and not args.model:
         p.error("--forward needs --model (the layer profile is per-model)")
+    if args.two_level and (
+        args.world_sizes or args.prior_extend or args.forward
+    ):
+        p.error("--two-level is its own calibration mode; it does not "
+                "combine with --world-sizes/--prior-extend/--forward")
     if args.forward:
         return _forward_main(args)
+    if args.two_level:
+        return _two_level_main(args)
 
     from mgwfbp_tpu.utils.platform import apply_platform_overrides
 
@@ -262,6 +286,59 @@ def main(argv: Optional[list[str]] = None) -> int:
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     save_profile(args.out, out_model, meta=meta)
     print(json.dumps(report))
+    return 0
+
+
+def _two_level_main(args) -> int:
+    """--two-level: per-axis (ici, dcn) calibration -> two_level profile
+    (`profiling.profile_two_level`; schema-stamped via save_profile)."""
+    from mgwfbp_tpu.utils.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    import os
+
+    import jax
+
+    from mgwfbp_tpu.parallel.costmodel import save_profile
+    from mgwfbp_tpu.profiling import profile_two_level
+
+    dcn = int(args.dcn)
+    if dcn <= 1:
+        raise SystemExit("--two-level needs --dcn > 1")
+    avail = len(jax.devices())
+    ici = int(args.ici) if args.ici else avail // dcn
+    if ici < 1 or ici * dcn > avail:
+        raise SystemExit(
+            f"--two-level: {ici} x {dcn} does not fit the {avail} "
+            "available device(s)"
+        )
+    sizes = tuple(2**k for k in range(args.min_log2, args.max_log2 + 1))
+    model, raw = profile_two_level(
+        ici, dcn, sizes=sizes, warmup=args.warmup, iters=args.iters,
+        allgather=args.allgather,
+    )
+    meta = {
+        "device_kind": jax.devices()[0].device_kind,
+        "mesh": {"ici": ici, "dcn": dcn},
+        "payload_log2_range": [args.min_log2, args.max_log2],
+        "iters": args.iters,
+        "fit": raw["fit"],
+        "ag_fraction": raw["ag_fraction"],
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    save_profile(args.out, model, meta=meta)
+    print(json.dumps({
+        "ici": {
+            "alpha_s": model.ici.alpha, "beta_s_per_byte": model.ici.beta,
+            "ag_fraction": raw["ag_fraction"],
+        },
+        "dcn": {
+            "alpha_s": model.dcn.alpha, "beta_s_per_byte": model.dcn.beta,
+        },
+        "mesh": {"ici": ici, "dcn": dcn},
+        "samples": len(raw["sizes_bytes"]),
+        "out": args.out,
+    }))
     return 0
 
 

@@ -33,6 +33,7 @@ from mgwfbp_tpu.parallel.solver import (
     build_schedule,
     check_unique,
     effective_cost_fn,
+    is_two_level,
     predict_group_times,
     simulate_groups,
     size_prior_tb,
@@ -54,10 +55,24 @@ GROUP_SCOPE_PREFIX = "mgwfbp_group"
 # this scope; keep the two in sync.
 CLIP_NORM_SCOPE = "sharded_clip_norm"
 
+# Name-scope prefix of the hier lowering's cross-slice (DCN) collectives:
+# one outer all-reduce per DCN group of the nested schedule, over the
+# concatenated member shards. Scoped SEPARATELY from the inner
+# mgwfbp_groupNNNN legs so the jaxpr verifier can pin the DCN contract
+# (count/payload/dtype, no stray cross-pod collectives — SCH009) and so
+# trace attribution can split a bucket's time into its ICI and DCN legs.
+# Keep in sync with analysis/jaxpr_check.py.
+DCN_GROUP_SCOPE_PREFIX = "mgwfbp_dcngroup"
+
 
 def group_scope_name(gi: int) -> str:
     """Name-scope label for merge group `gi` (introspection hook)."""
     return f"{GROUP_SCOPE_PREFIX}{gi:04d}"
+
+
+def dcn_group_scope_name(di: int) -> str:
+    """Name-scope label for DCN group `di` (hier lowering)."""
+    return f"{DCN_GROUP_SCOPE_PREFIX}{di:04d}"
 
 
 _DIGITS = re.compile(r"(\d+)")
@@ -846,6 +861,150 @@ def merged_rs_opt_ag(
     return new_params, new_state
 
 
+def merged_hier_allreduce(
+    tree: Any,
+    layout: BucketLayout,
+    dcn_groups: Sequence[Sequence[int]],
+    perm: Sequence[int],
+    axis_name: tuple[str, ...],
+    mean: bool = True,
+    comm_dtype: Optional[Any] = None,
+    sequential: bool = True,
+) -> Any:
+    """The hierarchical lowering of a NESTED schedule (comm_op='hier'):
+    three token-chained phases realizing exactly the two-link timeline
+    `solver.simulate_groups_two_level` prices.
+
+      1. per inner group, under its ``mgwfbp_groupNNNN`` scope: pack the
+         grad bucket, (wire-cast,) pad to inner-axis divisibility,
+         reduce-scatter over the INNER (ICI) axis — each device now holds
+         the slice-reduced 1/ici shard;
+      2. per DCN group, under its ``mgwfbp_dcngroupNNNN`` scope: ONE
+         all-reduce over the OUTER (DCN) axis of the members'
+         concatenated shards — the per-link merge decision made real:
+         small buckets amortize the DCN startup together while keeping
+         their ICI granularity;
+      3. per inner group, under its group scope again: mean-divide,
+         all-gather over the inner axis, trim the pad, unpack.
+
+    The token chains are PER LINK, mirroring the simulator's two serial
+    links exactly: the ICI chain threads RS0..RSn and then seeds the AG
+    phase (AGs start after the RS queue drains — the ici_free carry-over
+    of `simulate_groups_two_level`); the DCN collectives carry their OWN
+    chain, depending on each other plus — through ordinary dataflow on
+    the member shards — on exactly their members' reduce-scatters, and
+    each AG depends on its own post-DCN shard. A single global chain
+    would serialize the DCN hops behind the LAST reduce-scatter, which is
+    precisely the cross-link concurrency the two-link cost model prices
+    (DCN group 0 overlapping later RS legs); per-link chains keep the
+    issued dependency structure and the priced timeline the same shape.
+    The chains still stop XLA's collective combiners from re-merging
+    buckets or fusing the deliberately-separate DCN collectives.
+
+    Numerically identical to a flat psum/pmean over both axes: psum is
+    elementwise, so reducing concatenated shards together or apart
+    cannot change any element's value."""
+    if len(axis_name) != 2:
+        raise ValueError(
+            "merged_hier_allreduce needs axis_name=(inner_ici, outer_dcn)"
+        )
+    inner, outer = axis_name
+    world = axis_size(axis_name)
+    ici = axis_size((inner,))
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arr = [leaves[j] for j in perm]
+    shapes = [l.shape for l in arr]
+    from mgwfbp_tpu.parallel.solver import (
+        check_dcn_partition,
+        singleton_dcn_groups,
+    )
+
+    if not dcn_groups:
+        dcn_groups = singleton_dcn_groups(layout.num_groups)
+    check_dcn_partition(dcn_groups, layout.num_groups)
+
+    # ---- phase 1: per-group reduce-scatter over the inner (ICI) axis ----
+    ici_token = None
+    shards: list[jax.Array] = []
+    orig_dtypes: list[Any] = []
+    for gi in range(layout.num_groups):
+        with jax.named_scope(group_scope_name(gi)):
+            buf = buckets_lib.pack_group(arr, layout, gi)
+            orig_dtypes.append(buf.dtype)
+            if comm_dtype is not None and buf.dtype != comm_dtype:
+                buf = buf.astype(comm_dtype)
+            if sequential:
+                buf = _chain_token(buf, ici_token)
+            pad = (-buf.shape[0]) % ici
+            if pad:
+                buf = jnp.pad(buf, (0, pad))
+            shard = lax.psum_scatter(
+                buf, (inner,), scatter_dimension=0, tiled=True
+            )
+            ici_token = shard[0]
+            shards.append(shard)
+
+    # ---- phase 2: one cross-slice all-reduce per DCN group ----
+    # the DCN link's OWN chain: group di waits for di-1 (serial link) and
+    # — via the concatenated member shards themselves — for exactly its
+    # members' reduce-scatters, NOT the whole RS phase
+    dcn_token = None
+    for di, d in enumerate(dcn_groups):
+        members = [int(gi) for gi in d]
+        if len({shards[gi].dtype for gi in members}) > 1:
+            raise ValueError(
+                f"hier dcn group {di} mixes bucket dtypes "
+                f"{[str(shards[gi].dtype) for gi in members]}; split it at "
+                "dtype boundaries (solver.align_dcn_groups)"
+            )
+        with jax.named_scope(dcn_group_scope_name(di)):
+            cat = (
+                shards[members[0]]
+                if len(members) == 1
+                else jnp.concatenate([shards[gi] for gi in members])
+            )
+            if sequential:
+                cat = _chain_token(cat, dcn_token)
+            red = lax.psum(cat, outer)
+            dcn_token = red[0]
+            if len(members) == 1:
+                shards[members[0]] = red
+            else:
+                off = 0
+                for gi in members:
+                    ln = shards[gi].shape[0]
+                    shards[gi] = red[off:off + ln]
+                    off += ln
+
+    # ---- phase 3: per-group all-gather over the inner axis, unpack ----
+    # back on the ICI chain: the AG queue opens once the RS queue drained
+    # (ici_token still carries the last reduce-scatter), and each gather's
+    # input is its own post-DCN shard — the same gating the simulator's
+    # max(ici_free, dcn_done) start expresses
+    out: list[Any] = [None] * len(arr)
+    for gi in range(layout.num_groups):
+        with jax.named_scope(group_scope_name(gi)):
+            shard = shards[gi]
+            if mean:
+                shard = shard / world
+            if sequential:
+                shard = _chain_token(shard, ici_token)
+            full = lax.all_gather(shard, (inner,), axis=0, tiled=True)
+            ici_token = full[0]
+            n = layout.group_sizes[gi]
+            if full.shape[0] != n:
+                full = full[:n]
+            if full.dtype != orig_dtypes[gi]:
+                full = full.astype(orig_dtypes[gi])
+            unpacked = buckets_lib.unpack_group(full, layout, gi, shapes)
+        for i, a in unpacked.items():
+            out[i] = a
+    restored: list[Any] = [None] * len(leaves)
+    for k, j in enumerate(perm):
+        restored[j] = out[k]
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
 def merged_psum(
     tree: Any,
     layout: BucketLayout,
@@ -856,6 +1015,7 @@ def merged_psum(
     compressor: Optional[Any] = None,
     sequential: bool = True,
     comm_op: str = "all_reduce",
+    dcn_groups: Sequence[Sequence[int]] = (),
 ) -> Any:
     """All-reduce a gradient pytree group-by-group per the bucket layout.
 
@@ -901,6 +1061,14 @@ def merged_psum(
             "compressor (the compressor replaces the bucket collective)"
         )
     _check_hier_axes(comm_op, axis_name)
+    if comm_op == "hier":
+        # the hierarchical lowering realizes a NESTED schedule (per-group
+        # inner RS/AG + per-DCN-group outer collectives) — its own three-
+        # phase program, not a per-group swap-in
+        return merged_hier_allreduce(
+            tree, layout, dcn_groups, perm, tuple(axis_name),
+            mean=mean, comm_dtype=comm_dtype, sequential=sequential,
+        )
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arr = [leaves[j] for j in perm]
     shapes = [l.shape for l in arr]
@@ -931,8 +1099,6 @@ def merged_psum(
                 buf = compressor.allreduce(buf, axes, mean)
             elif comm_op == "rs_ag":
                 buf = _rs_ag_allreduce(buf, axes, mean)
-            elif comm_op == "hier":
-                buf = _hierarchical_allreduce(buf, axes[0], axes[1], mean)
             else:
                 buf = lax.pmean(buf, axes) if mean else lax.psum(buf, axes)
             token = buf[0]
@@ -993,6 +1159,7 @@ class MergedAllreduce:
             compressor=self.compressor,
             sequential=self.sequential,
             comm_op=self.comm_op,
+            dcn_groups=self.schedule.dcn_groups,
         )
 
     def reduce_and_update(
@@ -1084,6 +1251,7 @@ def make_merged_allreduce(
     optim_spec: Optional[OptimSpec] = None,
     world_size: Optional[int] = None,
     groups: Optional[Sequence[Sequence[int]]] = None,
+    dcn_groups: Optional[Sequence[Sequence[int]]] = None,
     policy_detail: Optional[str] = None,
 ) -> MergedAllreduce:
     """Build the merged-allreduce transform for a parameter pytree.
@@ -1104,7 +1272,12 @@ def make_merged_allreduce(
 
     groups: an EXPLICIT arrival-order grouping that bypasses the policy
     solve (autotuner candidates / schedule-cache hits; see
-    `solver.build_schedule`), labeled by `policy_detail`.
+    `solver.build_schedule`), labeled by `policy_detail`. For
+    comm_op='hier', `dcn_groups` is the matching explicit OUTER (DCN)
+    partition of the inner groups; absent, the solve (policy='auto'
+    under a two-level cost model) or the one-DCN-collective-per-group
+    default applies. The issued partition is re-aligned to the final
+    bucket layout (dtype splits) before anything lowers.
     """
     leaves = jax.tree_util.tree_leaves(params_or_shapes)
     n = len(leaves)
@@ -1152,14 +1325,51 @@ def make_merged_allreduce(
     schedule = build_schedule(
         specs, tb, tf=tf, policy=policy, cost_model=cost_model,
         threshold=threshold, comm_op=comm_op,
-        groups=groups, policy_detail=policy_detail,
+        groups=groups, dcn_groups=dcn_groups, policy_detail=policy_detail,
     )
     layout = build_layout(arr, schedule.groups)
-    if layout.groups != schedule.groups:
-        # build_layout split one or more groups at dtype boundaries; each
-        # split adds a real collective (and its alpha), so re-simulate the
-        # predictions on the groups actually issued.
-        schedule = dataclasses.replace(schedule, groups=layout.groups)
+    dcn_part = None
+    if comm_op == "hier":
+        # the DCN partition must describe the groups ACTUALLY issued:
+        # remap it across any dtype split of the inner groups, then split
+        # DCN groups themselves at dtype boundaries (one concatenated
+        # shard buffer per DCN collective needs one dtype)
+        from mgwfbp_tpu.parallel.solver import (
+            align_dcn_groups,
+            remap_dcn_groups,
+            singleton_dcn_groups,
+        )
+
+        dcn_part = [list(d) for d in schedule.dcn_groups] or (
+            singleton_dcn_groups(len(schedule.groups))
+        )
+        if layout.groups != schedule.groups:
+            dcn_part = remap_dcn_groups(
+                schedule.groups, layout.groups, dcn_part
+            )
+        if comm_dtype is None:
+            # a wire cast unifies every shard's dtype, so mixed-dtype DCN
+            # groups concat legally there — splitting anyway would pay an
+            # extra cross-slice alpha per step for nothing
+            dcn_part = align_dcn_groups(dcn_part, layout.dtypes)
+    layout_changed = layout.groups != schedule.groups
+    dcn_changed = comm_op == "hier" and tuple(
+        tuple(d) for d in dcn_part
+    ) != schedule.dcn_groups
+    if layout_changed or dcn_changed:
+        # build_layout split one or more groups at dtype boundaries (or
+        # the DCN partition re-aligned); each split adds a real collective
+        # (and its alpha), so re-simulate the predictions on the schedule
+        # actually issued.
+        schedule = dataclasses.replace(
+            schedule,
+            groups=layout.groups,
+            dcn_groups=(
+                tuple(tuple(int(i) for i in d) for d in dcn_part)
+                if dcn_part is not None
+                else schedule.dcn_groups
+            ),
+        )
         if tb is not None and cost_model is not None:
             cost_fn = effective_cost_fn(cost_model, comm_op)
             sizes_b = [s.nbytes for s in specs]
@@ -1175,6 +1385,21 @@ def make_merged_allreduce(
                     float(getattr(cost_model, "gamma", 0.0)),
                     float(getattr(cost_model, "overlap", 1.0)),
                     float(getattr(cost_model, "pack_beta", 0.0)),
+                )
+            elif comm_op == "hier" and is_two_level(cost_model):
+                from mgwfbp_tpu.parallel.solver import (
+                    simulate_groups_two_level,
+                    two_level_leg_costs,
+                )
+
+                rs_c, dcn_c, ag_c = two_level_leg_costs(cost_model)
+                total, nonoverlap, comm = simulate_groups_two_level(
+                    layout.groups, dcn_part, sizes_b, tb,
+                    rs_c, dcn_c, ag_c,
+                    gamma=float(getattr(cost_model.ici, "gamma", 0.0)),
+                    dcn_gamma=float(getattr(cost_model.dcn, "gamma", 0.0)),
+                    overlap=float(getattr(cost_model, "overlap", 1.0)),
+                    pack_beta=float(getattr(cost_model, "pack_beta", 0.0)),
                 )
             else:
                 total, nonoverlap, comm = simulate_groups(

@@ -60,12 +60,14 @@ CACHE_SCHEMA_VERSION = 1
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One schedule the tuner may race: an explicit grouping + lowering."""
+    """One schedule the tuner may race: an explicit grouping + lowering.
+    hier candidates additionally carry the nested DCN partition."""
 
     label: str
     groups: tuple[tuple[int, ...], ...]
     comm_op: str
     predicted_total_s: float = float("nan")
+    dcn_groups: tuple[tuple[int, ...], ...] = ()
 
 
 @dataclasses.dataclass
@@ -79,6 +81,7 @@ class RaceEntry:
     measured_step_s: Optional[float] = None
     predicted_total_s: Optional[float] = None
     groups: tuple[tuple[int, ...], ...] = ()
+    dcn_groups: tuple[tuple[int, ...], ...] = ()
 
     def to_json(self) -> dict:
         return {
@@ -89,18 +92,19 @@ class RaceEntry:
             "measured_step_s": self.measured_step_s,
             "predicted_total_s": self.predicted_total_s,
             "groups": [list(g) for g in self.groups],
+            "dcn_groups": [list(d) for d in self.dcn_groups],
         }
 
 
-def allowed_comm_ops(base: str) -> tuple[str, ...]:
+def allowed_comm_ops(base: str, multi_slice: bool = False) -> tuple[str, ...]:
     """Lowerings a candidate may race under, given the configured one.
 
     all_reduce and rs_ag are freely interchangeable (same replicated state,
-    numerically identical reduction), so candidates race under both. hier
-    is pinned to its two-axis mesh and rs_opt_ag owns the device-sharded
-    optimizer state (a different state layout per schedule is already
-    handled by the hot-swap seam, but a different *optimizer contract*
-    mid-run is not a tuning knob) — those race schedule shapes only.
+    numerically identical reduction), so candidates race under both.
+    rs_opt_ag owns the device-sharded optimizer state (a different state
+    layout per schedule is already handled by the hot-swap seam, but a
+    different *optimizer contract* mid-run is not a tuning knob) — it
+    races schedule shapes only.
 
     A run CONFIGURED for the cross-step rs_fwd_ag lowering races against
     the in-step interchangeable pair too: the user already opted into the
@@ -110,9 +114,23 @@ def allowed_comm_ops(base: str) -> tuple[str, ...]:
     the gathers actually beats hiding everything behind backward on this
     link. The reverse direction stays off (an all_reduce run never swaps
     INTO the sharded contract uninvited).
+
+    hier needs the (ici, dcn) two-axis mesh: `multi_slice=True` says the
+    live mesh has one, and then hier and the flat pair race each OTHER in
+    both directions — the grads-only lowerings all share the replicated
+    state, and whether the explicit hierarchy beats XLA's flat lowering
+    on THIS topology is exactly the measured question (the reference's
+    10GbE-vs-IB result, asked per pod). On a single-slice mesh hier
+    candidates cannot even build, so the flat pair stands alone.
     """
     if base in ("all_reduce", "rs_ag"):
-        return ("all_reduce", "rs_ag")
+        return (
+            ("all_reduce", "rs_ag", "hier")
+            if multi_slice
+            else ("all_reduce", "rs_ag")
+        )
+    if base == "hier":
+        return ("hier", "all_reduce", "rs_ag") if multi_slice else ("hier",)
     if base == "rs_fwd_ag":
         return ("rs_fwd_ag", "all_reduce", "rs_ag")
     return (base,)
@@ -126,13 +144,15 @@ def build_candidates(
     *,
     tf: Optional[Sequence[float]] = None,
     max_candidates: int = 6,
-    incumbent: Optional[tuple[Sequence[Sequence[int]], str]] = None,
+    incumbent: Optional[tuple] = None,
 ) -> list[Candidate]:
     """The candidate frontier: solver picks under each permitted lowering.
 
     Candidates are ranked by predicted total step time and capped at
-    `max_candidates`; the incumbent (the live solved schedule) is always
-    included — the race must be able to conclude "keep what we have".
+    `max_candidates`; the incumbent (the live solved schedule, a
+    ``(groups, comm_op)`` or ``(groups, comm_op, dcn_groups)`` tuple) is
+    always included — the race must be able to conclude "keep what we
+    have".
 
     tf: arrival-ordered per-layer forward profile for pricing cross-step
     (rs_fwd_ag) candidates — their `simulate_cross_step` totals are
@@ -150,6 +170,39 @@ def build_candidates(
     out: list[Candidate] = []
     seen: set[tuple] = set()
     for op in comm_ops:
+        if op == "hier":
+            # hier candidates come from the TWO-LEVEL frontier: nested
+            # (inner, dcn) partition pairs, priced by the two-link
+            # simulate — totals backward-anchored and directly comparable
+            # with the flat lowerings' simulate_groups totals
+            from mgwfbp_tpu.parallel.solver import (
+                is_two_level,
+                two_level_frontier,
+            )
+
+            if not is_two_level(cost_model):
+                continue  # no two-link pricing -> nothing solvable to race
+            for detail, groups, dcn_part, pred in two_level_frontier(
+                sizes, tb, cost_model, itemsizes,
+                max_candidates=max(max_candidates, 2),
+            ):
+                key = (
+                    op, tuple(map(tuple, groups)),
+                    tuple(map(tuple, dcn_part)),
+                )
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Candidate(
+                    label=f"{op}:{detail}",
+                    groups=tuple(tuple(int(i) for i in g) for g in groups),
+                    comm_op=op,
+                    predicted_total_s=float(pred),
+                    dcn_groups=tuple(
+                        tuple(int(i) for i in d) for d in dcn_part
+                    ),
+                ))
+            continue
         cost = effective_cost_fn(cost_model, op)
         cross = None
         if op == "rs_fwd_ag":
@@ -171,7 +224,7 @@ def build_candidates(
             max_candidates=max(max_candidates, 2),
             cross_step=cross,
         ):
-            key = (op, tuple(map(tuple, groups)))
+            key = (op, tuple(map(tuple, groups)), ())
             if key in seen:
                 continue
             seen.add(key)
@@ -197,12 +250,17 @@ def build_candidates(
     out = kept
     if incumbent is not None:
         inc_groups = tuple(tuple(int(i) for i in g) for g in incumbent[0])
-        key = (incumbent[1], inc_groups)
-        if key not in {(c.comm_op, c.groups) for c in out}:
+        inc_dcn = tuple(
+            tuple(int(i) for i in d)
+            for d in (incumbent[2] if len(incumbent) > 2 else ())
+        )
+        key = (incumbent[1], inc_groups, inc_dcn)
+        if key not in {(c.comm_op, c.groups, c.dcn_groups) for c in out}:
             inc = Candidate(
                 label=f"{incumbent[1]}:incumbent",
                 groups=inc_groups,
                 comm_op=incumbent[1],
+                dcn_groups=inc_dcn,
             )
             if len(out) >= max_candidates and len(out) > 1:
                 # make room WITHOUT collapsing group-count diversity: drop
@@ -251,8 +309,11 @@ def step_delta_observations(
 
 
 def model_summary(model) -> dict:
-    """The scalar cost-model fields a refit can move (cache provenance)."""
-    return {
+    """The scalar cost-model fields a refit can move (cache provenance).
+    Two-level models additionally record each link's constants — a
+    per-link refit is invisible in the aggregate scalars (TwoLevelAlphaBeta
+    has no flat beta at all)."""
+    out = {
         "alpha": float(getattr(model, "alpha", 0.0)),
         "beta": float(getattr(model, "beta", 0.0)),
         "gamma": float(getattr(model, "gamma", 0.0)),
@@ -260,6 +321,15 @@ def model_summary(model) -> dict:
         "pack_beta": float(getattr(model, "pack_beta", 0.0)),
         "update_beta": float(getattr(model, "update_beta", 0.0)),
     }
+    if hasattr(model, "ici") and hasattr(model, "dcn"):
+        for link in ("ici", "dcn"):
+            m = getattr(model, link)
+            out[link] = {
+                "alpha": float(getattr(m, "alpha", 0.0)),
+                "beta": float(getattr(m, "beta", 0.0)),
+                "gamma": float(getattr(m, "gamma", 0.0)),
+            }
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -282,6 +352,7 @@ def cache_key(
     density: Optional[float] = None,
     batch_size: Optional[int] = None,
     nsteps_update: Optional[int] = None,
+    dcn_slices: Optional[int] = None,
 ) -> str:
     """Filename-safe cache key — THE single authoritative statement of
     what a committed schedule is keyed by (README/ROADMAP refer here
@@ -303,12 +374,18 @@ def cache_key(
       * when set: ``comm_dtype`` (``_wire-<dtype>``) and
         ``compressor``/``density`` — they change the wire bytes the race
         optimized for (a winner tuned at bf16 wire or 1% density must not
-        be served to an f32 dense run).
+        be served to an f32 dense run);
+      * ``dcn_slices`` (``_dcn<N>``, when > 1) — the multi-slice mesh
+        shape: the same world split (4,2) vs (2,4) prices both links
+        differently and a hier winner's nested partition describes one
+        topology only.
 
     These are exactly the fields a schedule is NOT portable across;
     everything else (seed, logdir, epochs, ...) is deliberately excluded.
     """
     key = f"{_safe(model)}_w{int(world)}_{_safe(comm_op)}_{_safe(dtype)}"
+    if dcn_slices is not None and int(dcn_slices) > 1:
+        key += f"_dcn{int(dcn_slices)}"
     if batch_size is not None:
         key += f"_b{int(batch_size)}"
     if nsteps_update is not None and int(nsteps_update) > 1:

@@ -207,6 +207,106 @@ def refit_from_observations(
     )
 
 
+def refit_two_level_from_observations(
+    model: "TwoLevelAlphaBeta",
+    observations: Sequence[tuple[float, float]],
+    ici_observations: Optional[Sequence[tuple[float, float]]] = None,
+    dcn_observations: Optional[Sequence[tuple[float, float]]] = None,
+) -> "TwoLevelAlphaBeta":
+    """Refit a two-level model from live measurements, PER LINK when the
+    attribution separates them.
+
+    ici_observations / dcn_observations are per-leg (bytes, seconds)
+    samples — the `mgwfbp_groupNNNN` scopes time a bucket's ICI legs and
+    the `mgwfbp_dcngroupNNNN` scopes its DCN collective, so a profiler
+    trace that keeps scopes yields both lists (ici bytes are the FULL
+    bucket payload, dcn bytes the 1/ici_size shard payload actually on
+    the outer wire). Each link with >= 2 observations refits its own
+    alpha-beta (gamma subtracted from the intercept like
+    `refit_from_observations`); a link without enough samples keeps its
+    constants.
+
+    `observations` is the whole-collective fallback (step-delta pseudo
+    observations, which cannot separate the links): both links rescale by
+    the COMMON factor that matches the fitted effective line's per-byte
+    rate at the observed payloads — the residual says "the model is K x
+    off", not which wire is off, so the correction preserves the links'
+    measured proportions. Per-link lists take precedence when given.
+    """
+
+    def _refit_link(link, obs) -> AlphaBeta:
+        ab = fit_alpha_beta([b for b, _ in obs], [t for _, t in obs])
+        gamma = float(getattr(link, "gamma", 0.0))
+        return AlphaBeta(
+            alpha=max(ab.alpha - gamma, 0.0),
+            beta=ab.beta,
+            gamma=gamma,
+            overlap=float(getattr(link, "overlap", 1.0)),
+            pack_beta=float(getattr(link, "pack_beta", 0.0)),
+            update_beta=float(getattr(link, "update_beta", 0.0)),
+            ag_fraction=float(getattr(link, "ag_fraction", 0.5)),
+        )
+
+    ici, dcn = model.ici, model.dcn
+    per_link = False
+    if ici_observations is not None and len(ici_observations) >= 2:
+        ici = _refit_link(ici, ici_observations)
+        per_link = True
+    if dcn_observations is not None and len(dcn_observations) >= 2:
+        dcn = _refit_link(dcn, dcn_observations)
+        per_link = True
+    if not per_link:
+        obs = [(float(b), float(t)) for b, t in observations or []]
+        if len(obs) < 2:
+            raise ValueError(
+                "need at least two (bytes, seconds) observations "
+                "(per-link or whole-collective)"
+            )
+        # common drift factor: measured vs predicted whole-collective time
+        # at the observed payloads (gamma rides outside the link timeline,
+        # same convention as refit_from_observations)
+        gamma = float(model.gamma)
+        ratios = [
+            (t - gamma) / model.predict(b)
+            for b, t in obs
+            if model.predict(b) > 0.0 and t > gamma
+        ]
+        if not ratios:
+            raise ValueError("observations do not constrain the model")
+        k = float(np.median(ratios))
+
+        def _scale(link):
+            if isinstance(link, SampledCost):
+                # a measured curve stays a curve: scale the samples, not
+                # just the 2-parameter summary — collapsing to a line
+                # would discard exactly the payload-dependent shape the
+                # calibration persisted the curve FOR
+                return SampledCost(
+                    sizes_bytes=link.sizes_bytes,
+                    times_s=tuple(float(t) * k for t in link.times_s),
+                    ab=AlphaBeta(link.ab.alpha * k, link.ab.beta * k),
+                    gamma=link.gamma,
+                    overlap=link.overlap,
+                    pack_beta=link.pack_beta,
+                    update_beta=link.update_beta,
+                    ag_fraction=link.ag_fraction,
+                )
+            return AlphaBeta(
+                alpha=float(getattr(link, "alpha", 0.0)) * k,
+                beta=float(getattr(link, "beta", 0.0)) * k,
+                gamma=float(getattr(link, "gamma", 0.0)),
+                overlap=float(getattr(link, "overlap", 1.0)),
+                pack_beta=float(getattr(link, "pack_beta", 0.0)),
+                update_beta=float(getattr(link, "update_beta", 0.0)),
+                ag_fraction=float(getattr(link, "ag_fraction", 0.5)),
+            )
+
+        ici, dcn = _scale(ici), _scale(dcn)
+    return TwoLevelAlphaBeta(
+        ici=ici, dcn=dcn, ici_size=model.ici_size, dcn_size=model.dcn_size,
+    )
+
+
 def fit_alpha_beta(sizes_bytes: Sequence[float], times_s: Sequence[float]) -> AlphaBeta:
     """Closed-form least-squares fit of t = alpha + beta*size.
 
@@ -551,16 +651,37 @@ class TwoLevelAlphaBeta:
     term on the per-slice shard.
     """
 
-    ici: AlphaBeta
-    dcn: AlphaBeta
+    ici: "AlphaBeta | SampledCost"
+    dcn: "AlphaBeta | SampledCost"
     ici_size: int  # chips per slice
     dcn_size: int  # number of slices
 
     def predict(self, nbytes) -> float:
         if self.dcn_size <= 1:
             return self.ici.predict(nbytes)
-        shard = nbytes / max(self.ici_size, 1)
-        return self.ici.predict(nbytes) + self.dcn.predict(shard)
+        return self.ici.predict(nbytes) + self.dcn_shard_predict(nbytes)
+
+    # -- per-link predictors (the two-link solver's inputs) ---------------
+    # The hierarchical lowering is RS(ici, full payload) -> AR(dcn, the
+    # 1/ici_size shard) -> AG(ici, full payload); `predict` above is their
+    # sum. The two-link timeline simulator (solver.simulate_groups_two_level)
+    # races each leg on ITS link, so it needs the links separately — and the
+    # ICI side further split into its RS and AG legs by the INNER link's
+    # measured ag_fraction (each link carries its own ag_fraction; the DCN
+    # all-reduce is not split, it is one collective on the outer link).
+
+    def ici_predict(self, nbytes) -> float:
+        """Full ICI cost of one bucket (RS + AG legs together)."""
+        return float(self.ici.predict(nbytes))
+
+    def dcn_shard_predict(self, nbytes) -> float:
+        """DCN cost of one bucket: the cross-slice all-reduce moves only
+        the 1/ici_size shard the inner reduce-scatter produced. `nbytes`
+        is the FULL bucket payload; the shard division lives here so every
+        consumer prices the hierarchy identically."""
+        if self.dcn_size <= 1:
+            return 0.0
+        return float(self.dcn.predict(nbytes / max(self.ici_size, 1)))
 
     @property
     def alpha(self) -> float:
@@ -696,10 +817,13 @@ def save_profile(
     elif isinstance(model, SampledCost):
         doc = _model_dict(model)
     elif isinstance(model, TwoLevelAlphaBeta):
+        # per-link members may be SampledCost curves (the --two-level
+        # calibration persists the measured per-axis sweeps, not just the
+        # 2-parameter fits); _model_dict/_model_from_dict carry both forms
         doc = {
             "kind": "two_level",
-            "ici": dataclasses.asdict(model.ici),
-            "dcn": dataclasses.asdict(model.dcn),
+            "ici": _model_dict(model.ici),
+            "dcn": _model_dict(model.dcn),
             "ici_size": model.ici_size,
             "dcn_size": model.dcn_size,
         }
@@ -727,8 +851,8 @@ def load_profile(
     d.pop("meta", None)
     if kind == "two_level":
         return TwoLevelAlphaBeta(
-            ici=AlphaBeta(**d["ici"]),
-            dcn=AlphaBeta(**d["dcn"]),
+            ici=_model_from_dict(d["ici"]),
+            dcn=_model_from_dict(d["dcn"]),
             ici_size=d["ici_size"],
             dcn_size=d["dcn_size"],
         )

@@ -210,6 +210,340 @@ def simulate_cross_step(
     return total, total - tb_total, comm_sum
 
 
+# ---------------------------------------------------------------------------
+# Two-link (ICI + DCN) scheduling: the hierarchical lowering's timeline.
+#
+# A multi-slice pod has TWO interconnects at once — fast ICI inside a slice,
+# slow DCN across slices — and the paper's own result (the 10GbE and IB
+# clusters of arXiv:1912.09268 solve to different groupings) says the merge
+# schedule is a function of the link. So a hier schedule is a PAIR of nested
+# partitions: the inner (ICI) grouping of layers, plus an outer (DCN)
+# grouping of those inner groups — small buckets may merge on the
+# high-latency DCN link while staying split on ICI (amortizing the DCN
+# alpha without giving up ICI-side overlap granularity).
+# ---------------------------------------------------------------------------
+
+
+def is_two_level(cost_model) -> bool:
+    """Duck-typed: does this model price two link classes separately?"""
+    return (
+        cost_model is not None
+        and hasattr(cost_model, "ici")
+        and hasattr(cost_model, "dcn")
+        and int(getattr(cost_model, "dcn_size", 1)) > 1
+    )
+
+
+def two_level_leg_costs(cost_model) -> tuple[CostFn, CostFn, CostFn]:
+    """(rs_cost, dcn_cost, ag_cost) per bucket for the hier lowering.
+
+    All three take the FULL bucket payload in bytes. The ICI side splits
+    into its RS and AG legs by the INNER link's measured ag_fraction
+    (calibrate --allgather; 0.5 prior); the DCN leg is the outer-link
+    all-reduce of the 1/ici_size shard (`TwoLevelAlphaBeta.
+    dcn_shard_predict` owns the shard division). The three sum to
+    `cost_model.predict` by construction, so per-group totals and the
+    two-link simulate can never disagree on a bucket's wire time."""
+    ici = cost_model.ici
+    af = float(getattr(ici, "ag_fraction", 0.5))
+    af = min(max(af, MIN_AG_FRACTION), 1.0 - MIN_AG_FRACTION)
+
+    def rs_cost(nbytes: float) -> float:
+        return (1.0 - af) * float(ici.predict(nbytes))
+
+    def ag_cost(nbytes: float) -> float:
+        return af * float(ici.predict(nbytes))
+
+    return rs_cost, cost_model.dcn_shard_predict, ag_cost
+
+
+def singleton_dcn_groups(num_groups: int) -> list[list[int]]:
+    """One DCN collective per inner group — the pre-nesting hier shape
+    (and the default for explicit/non-auto schedules)."""
+    return [[gi] for gi in range(num_groups)]
+
+
+def check_dcn_partition(
+    dcn_groups: Sequence[Sequence[int]], num_groups: int
+) -> None:
+    """A DCN partition must cover every inner-group index exactly once
+    (a gap means a bucket whose cross-slice reduction never happens —
+    silently wrong gradients)."""
+    flat = sorted(i for d in dcn_groups for i in d)
+    if flat != list(range(num_groups)):
+        raise ValueError(
+            f"dcn_groups must cover every inner-group index exactly once "
+            f"(got {num_groups} groups, partition {list(dcn_groups)})"
+        )
+
+
+def simulate_groups_two_level(
+    groups: Sequence[Sequence[int]],
+    dcn_groups: Sequence[Sequence[int]],
+    sizes_bytes: Sequence[int],
+    tb: Sequence[float],
+    rs_cost: CostFn,
+    dcn_cost: CostFn,
+    ag_cost: CostFn,
+    gamma: float = 0.0,
+    dcn_gamma: float = 0.0,
+    overlap: float = 1.0,
+    pack_beta: float = 0.0,
+) -> tuple[float, float, float]:
+    """Two-link timeline of the hierarchical lowering for a nested
+    schedule. Returns (total, nonoverlap, comm_time), comparable with
+    `simulate_groups` (both are backward-anchored).
+
+    Two serial links race the backward pass:
+
+      * ICI link: each inner group's reduce-scatter starts when its last
+        gradient is ready and the link is free (the taoc recurrence);
+        after the RS phase the same link carries the all-gathers, each
+        gated on its DCN group's cross-slice reduction landing — the
+        phase order the lowering's token chain realizes.
+      * DCN link: one all-reduce per DCN group over the concatenated
+        member shards (payload = the members' 1/ici_size shards), issued
+        when the group's LAST member's reduce-scatter completes.
+
+    `gamma` is the per-inner-group fixed overhead (pack/dispatch on the
+    ICI side), `dcn_gamma` the per-DCN-collective one — nesting exists
+    exactly to trade the latter against DCN-link wait. `pack_beta`
+    charges the bucketization copy per byte of multi-member inner groups
+    plus the shard concat of multi-member DCN groups."""
+    groups = list(groups)
+    dcn_groups = [list(d) for d in dcn_groups]
+    check_dcn_partition(dcn_groups, len(groups))
+    ready = np.cumsum(np.asarray(tb, dtype=np.float64))
+    bwd_end = float(ready[-1]) if len(ready) else 0.0
+    gbytes = [float(sum(sizes_bytes[i] for i in g)) for g in groups]
+
+    # ---- ICI link, RS phase ----
+    ici_free = 0.0
+    comm_sum = 0.0
+    pack_bytes = 0.0
+    rs_done = [0.0] * len(groups)
+    for gi, g in enumerate(groups):
+        t = rs_cost(gbytes[gi])
+        start = max(ici_free, float(ready[max(g)]) if len(g) else 0.0)
+        ici_free = start + t
+        rs_done[gi] = ici_free
+        comm_sum += t
+        if len(g) > 1:
+            pack_bytes += gbytes[gi]
+
+    # ---- DCN link: one cross-slice all-reduce per DCN group ----
+    dcn_free = 0.0
+    dcn_done = [0.0] * len(groups)
+    for d in dcn_groups:
+        dbytes = float(sum(gbytes[gi] for gi in d))
+        t = dcn_cost(dbytes)
+        start = max(dcn_free, max(rs_done[gi] for gi in d))
+        dcn_free = start + t
+        for gi in d:
+            dcn_done[gi] = dcn_free
+        comm_sum += t
+        # multi-member DCN groups concat/split their members' SHARD
+        # buffers (1/ici_size of the bucket each) — a copy so small next
+        # to the inner-side bucket pack that charging it would only add
+        # an ici_size knob to every caller; left unpriced by design
+
+    # ---- ICI link, AG phase (after the RS queue; gated per DCN group) ----
+    for gi in range(len(groups)):
+        t = ag_cost(gbytes[gi])
+        start = max(ici_free, dcn_done[gi])
+        ici_free = start + t
+        comm_sum += t
+
+    overhead = (
+        gamma * len(groups) + dcn_gamma * len(dcn_groups)
+        + pack_beta * pack_bytes
+    )
+    total_hidden = max(bwd_end, ici_free, dcn_free)
+    total_serial = bwd_end + comm_sum
+    ov = min(max(overlap, 0.0), 1.0)
+    total = ov * total_hidden + (1.0 - ov) * total_serial + overhead
+    return total, total - bwd_end, comm_sum
+
+
+def dcn_partition_candidates(
+    groups: Sequence[Sequence[int]],
+    sizes_bytes: Sequence[int],
+    tb: Sequence[float],
+    rs_cost: CostFn,
+    dcn_cost: CostFn,
+    dcn_alpha: float,
+    dcn_gamma: float = 0.0,
+) -> list[tuple[str, list[list[int]]]]:
+    """Candidate DCN partitions for a FIXED inner grouping, deduped.
+
+    The outer link sees each inner group as one "layer": its payload is
+    the group's (full-bucket) bytes and its arrival time the completion
+    of its reduce-scatter on the ICI link. Candidates: one collective per
+    group (the pre-nesting shape), everything in one, and the mgwfbp scan
+    re-run ON THE DCN LINK — the per-link merge decision this module
+    exists for (small groups merge on DCN but stay split on ICI when the
+    DCN alpha dominates their shard payloads)."""
+    ready = np.cumsum(np.asarray(tb, dtype=np.float64))
+    gbytes = [int(sum(sizes_bytes[i] for i in g)) for g in groups]
+    ici_free = 0.0
+    rs_done = []
+    for gi, g in enumerate(groups):
+        start = max(ici_free, float(ready[max(g)]) if len(g) else 0.0)
+        ici_free = start + rs_cost(float(gbytes[gi]))
+        rs_done.append(ici_free)
+    # per-"layer" time deltas whose cumsum reproduces the arrival times
+    tb_dcn = [rs_done[0]] + [
+        rs_done[i] - rs_done[i - 1] for i in range(1, len(rs_done))
+    ]
+    n = len(groups)
+    out: list[tuple[str, list[list[int]]]] = [
+        ("per-group", singleton_dcn_groups(n)),
+        ("single", [list(range(n))] if n else []),
+    ]
+    if n:
+        out.append((
+            "scan",
+            mgwfbp_groups(
+                gbytes, tb_dcn, alpha=dcn_alpha, cost=dcn_cost,
+                itemsize=1, gamma=dcn_gamma,
+            ),
+        ))
+    seen: set = set()
+    deduped = []
+    for detail, part in out:
+        key = tuple(map(tuple, part))
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append((detail, part))
+    return deduped
+
+
+def two_level_frontier(
+    sizes: Sequence[int],
+    tb: Sequence[float],
+    cost_model,
+    itemsize: int | Sequence[int] = 4,
+    max_candidates: int = 6,
+) -> list[tuple[str, list[list[int]], list[list[int]], float]]:
+    """Ranked nested schedules for the hier lowering: (detail, groups,
+    dcn_groups, predicted_total_s), cheapest first.
+
+    Inner candidates come from `candidate_groupings` priced on the ICI
+    link (its RS+AG legs are what occupy that link; the DCN hop rides a
+    different wire and must not distort the inner merge rule); each inner
+    candidate is then nested under every `dcn_partition_candidates` pick
+    and the pair scored by the two-link simulate. This IS the per-link
+    merge decision: the argmin is free to keep buckets split on ICI while
+    merging their cross-slice reductions on DCN."""
+    L = len(sizes)
+    if L == 0:
+        return []
+    if not is_two_level(cost_model):
+        raise ValueError(
+            "two_level_frontier needs a TwoLevelAlphaBeta-shaped cost "
+            f"model (got {type(cost_model).__name__})"
+        )
+    itemsizes = [itemsize] * L if isinstance(itemsize, int) else list(itemsize)
+    nbytes = [int(s) * it for s, it in zip(sizes, itemsizes)]
+    rs_cost, dcn_cost, ag_cost = two_level_leg_costs(cost_model)
+    ici = cost_model.ici
+    dcn = cost_model.dcn
+    gamma = float(getattr(ici, "gamma", 0.0))
+    dcn_gamma = float(getattr(dcn, "gamma", 0.0))
+    overlap = float(getattr(cost_model, "overlap", 1.0))
+    pack_beta = float(getattr(cost_model, "pack_beta", 0.0))
+    ici_cost = ici.predict
+    scored: list[tuple[str, list[list[int]], list[list[int]], float]] = []
+    seen: set = set()
+    for inner_detail, groups in candidate_groupings(
+        sizes, tb, float(getattr(ici, "alpha", 0.0)), ici_cost, itemsizes,
+        gamma=gamma, pack_beta=pack_beta,
+    ):
+        for dcn_detail, part in dcn_partition_candidates(
+            groups, nbytes, tb, rs_cost, dcn_cost,
+            dcn_alpha=float(getattr(dcn, "alpha", 0.0)),
+            dcn_gamma=dcn_gamma,
+        ):
+            key = (tuple(map(tuple, groups)), tuple(map(tuple, part)))
+            if key in seen:
+                continue
+            seen.add(key)
+            total, _, _ = simulate_groups_two_level(
+                groups, part, nbytes, tb, rs_cost, dcn_cost, ag_cost,
+                gamma=gamma, dcn_gamma=dcn_gamma, overlap=overlap,
+                pack_beta=pack_beta,
+            )
+            scored.append((
+                f"{inner_detail}/dcn-{dcn_detail}", groups, part,
+                float(total),
+            ))
+    scored.sort(key=lambda c: c[3])
+    return scored[: max(max_candidates, 1)]
+
+
+def remap_dcn_groups(
+    old_groups: Sequence[Sequence[int]],
+    new_groups: Sequence[Sequence[int]],
+    dcn_groups: Sequence[Sequence[int]],
+) -> list[list[int]]:
+    """Carry a DCN partition across a refinement of the inner grouping
+    (`buckets.build_layout` splits dtype-mixed groups): every new group
+    descends from exactly one old group, and inherits its DCN membership.
+    Order within each DCN group follows the new (arrival) indices."""
+    member_to_old: dict[int, int] = {}
+    for oi, g in enumerate(old_groups):
+        for i in g:
+            member_to_old[i] = oi
+    new_owner = [member_to_old[g[0]] for g in new_groups]
+    out: list[list[int]] = []
+    for d in dcn_groups:
+        want = set(int(i) for i in d)
+        members = [ni for ni, oi in enumerate(new_owner) if oi in want]
+        if members:
+            out.append(members)
+    return out
+
+
+def align_dcn_groups(
+    dcn_groups: Sequence[Sequence[int]], dtypes: Sequence
+) -> list[list[int]]:
+    """Split DCN groups at bucket-dtype boundaries: one DCN collective
+    concatenates its members' shards into ONE buffer, which only exists
+    for a homogeneous dtype. Each split adds a real cross-slice
+    collective (and its DCN alpha), so callers re-simulate predictions
+    on the partition actually issued."""
+    out: list[list[int]] = []
+    for d in dcn_groups:
+        run: list[int] = []
+        for gi in d:
+            if run and dtypes[gi] != dtypes[run[-1]]:
+                out.append(run)
+                run = []
+            run.append(int(gi))
+        if run:
+            out.append(run)
+    return out
+
+
+def auto_groups_two_level(
+    sizes: Sequence[int],
+    tb: Sequence[float],
+    cost_model,
+    itemsize: int | Sequence[int] = 4,
+) -> tuple[list[list[int]], list[list[int]], str]:
+    """`auto_groups` for the hierarchical lowering: argmin over the
+    two-level frontier. Returns (groups, dcn_groups, detail) — a PAIR of
+    nested partitions, the schedule shape a two-interconnect topology
+    actually calls for."""
+    if len(sizes) == 0:
+        return [], [], "empty"
+    best = two_level_frontier(
+        sizes, tb, cost_model, itemsize, max_candidates=1
+    )[0]
+    return best[1], best[2], best[0]
+
+
 @dataclasses.dataclass(frozen=True)
 class LayerSpec:
     """One gradient tensor, in arrival order."""
@@ -240,10 +574,20 @@ class MergeSchedule:
     # which candidate won when policy='auto' ('mgwfbp', 'wfbp', 'single',
     # or 'threshold:<elems>'); empty for direct policies
     policy_detail: str = ""
+    # hier (two-level) only: the OUTER (DCN) partition — groups of
+    # inner-group indices, arrival order; each DCN group issues ONE
+    # cross-slice collective over its members' concatenated shards. Empty
+    # for flat lowerings (and treated as one-DCN-collective-per-group by
+    # the hier lowering when a two-level solve never ran).
+    dcn_groups: tuple[tuple[int, ...], ...] = ()
 
     @property
     def num_groups(self) -> int:
         return len(self.groups)
+
+    @property
+    def num_dcn_groups(self) -> int:
+        return len(self.dcn_groups) if self.dcn_groups else len(self.groups)
 
     def named_groups(self) -> list[list[str]]:
         return [[self.layer_names[i] for i in g] for g in self.groups]
@@ -684,6 +1028,7 @@ def build_schedule(
     threshold: int = 0,
     comm_op: str = "all_reduce",
     groups: Optional[Sequence[Sequence[int]]] = None,
+    dcn_groups: Optional[Sequence[Sequence[int]]] = None,
     policy_detail: Optional[str] = None,
 ) -> MergeSchedule:
     """Build a MergeSchedule for gradient tensors in arrival order.
@@ -709,6 +1054,13 @@ def build_schedule(
     enter here. Must cover every layer index exactly once; predictions are
     still simulated under the cost model so the schedule stays comparable
     to solved ones. `policy_detail` labels its provenance.
+
+    comm_op='hier' with a two-level cost model schedules BOTH links: the
+    'auto' policy argmins over the nested frontier
+    (`auto_groups_two_level`), an explicit `dcn_groups` partition rides
+    through (cache hits / raced candidates), and every other policy keeps
+    one DCN collective per inner group; predictions come from the
+    two-link simulator either way.
     """
     sizes = [l.size for l in layers]
     names = tuple(l.name for l in layers)
@@ -724,6 +1076,7 @@ def build_schedule(
     cross_step = comm_op == "rs_fwd_ag"
     if cross_step and tb is not None and tf is None:
         tf = forward_prior_tf(tb)
+    two_level = comm_op == "hier" and is_two_level(cost_model)
     scan_cost = cost_fn
     if cross_step and cost_model is not None:
         # the merge rule scans BACKWARD arrivals against the link — on the
@@ -731,6 +1084,11 @@ def build_schedule(
         scan_cost, _ = cross_step_phase_costs(cost_model)
 
     detail = ""
+    dcn_part: Optional[list[list[int]]] = (
+        [list(int(i) for i in d) for d in dcn_groups]
+        if dcn_groups is not None
+        else None
+    )
     if groups is not None:
         fixed = [list(int(i) for i in g) for g in groups]
         if sorted(i for g in fixed for i in g) != list(range(len(layers))):
@@ -754,7 +1112,12 @@ def build_schedule(
     elif policy == "auto":
         if tb is None or cost_model is None:
             raise ValueError("policy 'auto' requires tb and cost_model")
-        if cross_step:
+        if two_level:
+            groups, dcn_part, detail = auto_groups_two_level(
+                sizes, tb, cost_model,
+                itemsize=[l.itemsize for l in layers],
+            )
+        elif cross_step:
             groups, detail = auto_groups_cross_step(
                 sizes,
                 tb,
@@ -782,8 +1145,23 @@ def build_schedule(
     else:
         raise ValueError(f"unknown policy {policy!r}")
 
+    if comm_op == "hier":
+        if dcn_part is None:
+            dcn_part = singleton_dcn_groups(len(groups))
+        check_dcn_partition(dcn_part, len(groups))
+    else:
+        dcn_part = None
+
     if tb is not None and cost_model is not None and len(layers):
-        if cross_step:
+        if two_level:
+            rs_c, dcn_c, ag_c = two_level_leg_costs(cost_model)
+            total, nonoverlap, comm = simulate_groups_two_level(
+                groups, dcn_part, nbytes, tb, rs_c, dcn_c, ag_c,
+                gamma=float(getattr(cost_model.ici, "gamma", 0.0)),
+                dcn_gamma=float(getattr(cost_model.dcn, "gamma", 0.0)),
+                overlap=overlap, pack_beta=pack_beta,
+            )
+        elif cross_step:
             rs_c, ag_c = cross_step_phase_costs(cost_model)
             total, nonoverlap, comm = simulate_cross_step(
                 groups, nbytes, tb, tf, rs_c, ag_c, gamma, overlap,
@@ -805,6 +1183,11 @@ def build_schedule(
         predicted_comm_time=comm,
         predicted_group_times=group_times,
         policy_detail=detail,
+        dcn_groups=(
+            tuple(tuple(int(i) for i in d) for d in dcn_part)
+            if dcn_part is not None
+            else ()
+        ),
     )
 
 

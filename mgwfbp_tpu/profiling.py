@@ -143,6 +143,127 @@ def profile_allgather(
     )
 
 
+def profile_two_level(
+    ici: int,
+    dcn: int,
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    warmup: int = 5,
+    iters: int = 20,
+    allgather: bool = False,
+    noop_baseline: bool = False,
+    devices: Optional[Sequence] = None,
+    dtype=jnp.float32,
+):
+    """Per-axis alpha-beta calibration of an (ici x dcn) two-axis mesh —
+    the `calibrate --two-level` engine (previously private to
+    tools/two_level_validation.py).
+
+    Times a pmean over ONLY the inner (data/ICI) axis and ONLY the outer
+    (dcn) axis at every payload size. ``noop_baseline=True`` additionally
+    sweeps a no-collective program (each standalone sweep bakes one
+    program dispatch into its curve; a fused hierarchical program pays it
+    once, so composition consumers subtract it — the validation tool's
+    dispatch correction; the calibrate CLI has no consumer for it, so the
+    default skips that third of the sweep wall time). With
+    ``allgather=True`` a tiled inner-axis AG sweep additionally fits the
+    ICI link's ag_fraction (the RS/AG split the two-link solver's leg
+    costs use).
+
+    Returns (model, raw): `model` is a TwoLevelAlphaBeta whose members
+    are full SampledCost curves (persist with `costmodel.save_profile` —
+    schema-stamped, loads anywhere a two-level profile loads), `raw` the
+    per-size sweeps keyed by FULL payload bytes plus the mesh/axis names
+    for callers that keep measuring on the same mesh (the validation
+    tool's hier-vs-flat sweep).
+
+    On a virtual CPU mesh both "axes" share one memory fabric, so the
+    constants differ only by group size/contention — fine for validating
+    the model's COMPOSITION, meaningless as DCN physics; calibrate on a
+    real multi-slice topology for production constants."""
+    from mgwfbp_tpu.parallel.costmodel import SampledCost, TwoLevelAlphaBeta
+    from mgwfbp_tpu.parallel.mesh import DCN_AXIS, MeshSpec, make_mesh
+
+    if dcn <= 1:
+        raise ValueError(f"--two-level needs dcn > 1 (got {dcn})")
+    mesh = make_mesh(
+        MeshSpec(data=ici, dcn=dcn),
+        devices=(
+            list(devices)[: ici * dcn]
+            if devices is not None
+            else jax.devices()[: ici * dcn]
+        ),
+    )
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def sweep(body) -> dict[int, float]:
+        out = {}
+        for n in sizes:
+            fn = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False,
+            ))
+            x = jnp.ones((n,), dtype)
+            for _ in range(warmup):
+                fn(x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn(x).block_until_ready()
+            out[n * itemsize] = (time.perf_counter() - t0) / iters
+        return out
+
+    t_ici = sweep(lambda x: lax.pmean(x, DATA_AXIS))
+    t_dcn = sweep(lambda x: lax.pmean(x, DCN_AXIS))
+    t_noop = sweep(lambda x: x * 1.0) if noop_baseline else {}
+    nbytes = sorted(t_ici)
+    ab_ici = fit_alpha_beta(nbytes, [t_ici[b] for b in nbytes])
+    ab_dcn = fit_alpha_beta(nbytes, [t_dcn[b] for b in nbytes])
+    ag_fraction = 0.5
+    if allgather:
+        full = CommProfile(
+            sizes_bytes=list(nbytes),
+            times_s=[t_ici[b] for b in nbytes],
+            model=ab_ici,
+        )
+        ag_prof = profile_allgather(
+            mesh, sizes=sizes, warmup=warmup, iters=iters,
+            axis_name=DATA_AXIS, dtype=dtype,
+        )
+        ag_fraction = fit_ag_fraction(full, ag_prof)
+    # sampled curves, not just the 2-parameter fits: one flat beta cannot
+    # describe payload-dependent per-byte cost (cache regimes on CPU, DMA
+    # pipelining on TPU) — same reason flat calibrations persist curves
+    model = TwoLevelAlphaBeta(
+        ici=SampledCost(
+            sizes_bytes=tuple(nbytes),
+            times_s=tuple(t_ici[b] for b in nbytes),
+            ab=ab_ici,
+            ag_fraction=ag_fraction,
+        ),
+        dcn=SampledCost(
+            sizes_bytes=tuple(nbytes),
+            times_s=tuple(t_dcn[b] for b in nbytes),
+            ab=ab_dcn,
+        ),
+        ici_size=int(ici),
+        dcn_size=int(dcn),
+    )
+    raw = {
+        "mesh": mesh,
+        "inner_axis": DATA_AXIS,
+        "outer_axis": DCN_AXIS,
+        "sizes_bytes": list(nbytes),
+        "ici_s": t_ici,
+        "dcn_s": t_dcn,
+        "noop_s": t_noop,
+        "ag_fraction": ag_fraction,
+        "fit": {
+            "ici": {"alpha": ab_ici.alpha, "beta": ab_ici.beta},
+            "dcn": {"alpha": ab_dcn.alpha, "beta": ab_dcn.beta},
+        },
+    }
+    return model, raw
+
+
 def fit_ag_fraction(
     full: CommProfile, ag: CommProfile,
     lo: float = 0.05, hi: float = 0.95,

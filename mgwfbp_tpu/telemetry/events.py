@@ -55,10 +55,14 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # start_s relative to the stream's epoch (header wall)
     "step": ("step", "epoch", "start_s", "dur_s"),
     # one merge group's comm span within the step timeline (model-replayed
-    # start, measured or predicted duration; see telemetry.overlap)
+    # start, measured or predicted duration; see telemetry.overlap).
+    # Hierarchical (hier) regimes additionally carry ici_s/dcn_s — the
+    # group's comm split by link — and cross-step regimes ag_start_s/ag_s.
     "comm_group": ("step", "group", "nbytes", "comm_s", "start_s",
                    "hidden_s", "exposed_s", "attribution"),
-    # aggregate overlap-efficiency snapshot for the surrounding step regime
+    # aggregate overlap-efficiency snapshot for the surrounding step
+    # regime; hier regimes add ici_s/dcn_s/bottleneck_link (which
+    # interconnect carries the larger comm share)
     "overlap": ("step", "epoch", "step_s", "tb_total_s", "comm_s",
                 "hidden_s", "exposed_s", "efficiency", "attribution"),
     # ScalarWriter view: the legacy scalar rows, now in the same stream

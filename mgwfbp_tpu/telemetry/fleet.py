@@ -22,7 +22,14 @@ binds), so it serves the fan-in:
                    window instead of merged spans), the slowest-process
                    attribution, the union of active drift/straggler
                    alarms across the group (each tagged with its emitting
-                   process), and the unreachable list.
+                   process), the per-process deep-profiling window table
+                   (each child's /profile state machine + last result),
+                   and the unreachable list;
+  /fleet/profile   ``?steps=N`` fans the per-process /profile?steps=N arm
+                   out to EVERY child in one call (ISSUE 11, the ROADMAP
+                   fleet seam) — per-child timeouts, per-child outcome in
+                   the response; without a query, the aggregated
+                   per-process window table alone.
 
 Every child scrape carries a HARD timeout and the children are scraped
 concurrently, so one wedged child makes the fan-in report it unreachable
@@ -177,6 +184,69 @@ def active_alarms(children: list[ChildScrape]) -> list[dict]:
     )
 
 
+def profile_windows(children: list[ChildScrape]) -> list[dict]:
+    """One row per reachable child: its /profile window state machine
+    (idle/armed/running/done/failed) and, when a window completed, the
+    attribution + per-group table the child posted — the fleet-level view
+    of PR 10's on-demand deep profiling."""
+    rows = []
+    for c in children:
+        if not c.reachable:
+            continue
+        prof = (c.status or {}).get("profile") or {}
+        row = {
+            "process": c.process,
+            "supported": prof.get("supported", False),
+            "state": prof.get("state", "idle"),
+        }
+        for k in ("steps", "error"):
+            if prof.get(k) is not None:
+                row[k] = prof[k]
+        result = prof.get("result")
+        if result is not None:
+            row["result"] = result
+        rows.append(row)
+    return rows
+
+
+def arm_fleet_profile(
+    targets: TargetMap, steps, timeout_s: float = SCRAPE_TIMEOUT_S,
+) -> dict:
+    """Fan /profile?steps=N out to every child concurrently (the ROADMAP
+    '/fleet/profile' seam: a multi-host profile window is armed per
+    process, and the step loop enters it in lockstep at the next
+    agree-interval boundary — arming every child in ONE call is what
+    makes the lockstep window reachable from outside). Per-child hard
+    timeouts; a dead child is an entry in the response, never a hang."""
+    steps = int(steps)  # the value is re-spliced into child URLs
+
+    def arm_one(idx: int, host: str, port: int) -> tuple[int, dict]:
+        try:
+            doc = json.loads(_http_get(
+                f"http://{host}:{port}/profile?steps={steps}", timeout_s
+            ))
+            return idx, {"armed": True, **doc}
+        except Exception as e:  # noqa: BLE001 — refused/timeout expected
+            return idx, {"armed": False, "error": str(e)}
+
+    out: dict = {"steps": steps, "processes": {}}
+    items = sorted(targets.items())
+    if not items:
+        return out
+    with ThreadPoolExecutor(max_workers=min(len(items), 16)) as pool:
+        futs = [
+            pool.submit(arm_one, idx, host, port)
+            for idx, (host, port) in items
+        ]
+        for f in futs:
+            idx, doc = f.result()
+            out["processes"][str(idx)] = doc
+    out["armed"] = sum(
+        1 for d in out["processes"].values() if d.get("armed")
+    )
+    return out
+
+
 def fleet_status(
     children: list[ChildScrape], meta: Optional[dict] = None,
 ) -> dict:
@@ -208,6 +278,7 @@ def fleet_status(
         "straggler_table": table,
         "slowest_process": slowest,
         "active_alarms": active_alarms(children),
+        "profile_windows": profile_windows(children),
     }
     if meta:
         doc.update(meta)
@@ -270,13 +341,34 @@ def write_fleet_sd(
 
 class _FleetHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        from urllib.parse import parse_qs, urlsplit
+
         srv: FleetServer = self.server.fleet  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        split = urlsplit(self.path)
+        path = split.path.rstrip("/") or "/"
         try:
             if path == "/fleet/metrics":
                 body = srv.render_metrics().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
                 code = 200
+            elif path == "/fleet/profile":
+                query = parse_qs(split.query)
+                code = 200
+                if "steps" in query:
+                    # validate HERE: the raw decoded value is re-spliced
+                    # into every child URL, so garbage (or smuggled query
+                    # params) must die at the fan-in, not fan out
+                    try:
+                        steps = int(query["steps"][-1])
+                    except ValueError:
+                        doc = {"error": "steps must be an integer"}
+                        code = 400
+                    else:
+                        doc = srv.arm_profile(steps)
+                else:
+                    doc = {"profile_windows": srv.render_profile_windows()}
+                body = (json.dumps(doc, indent=1) + "\n").encode()
+                ctype = "application/json"
             elif path in ("/fleet/status", "/"):
                 body = (
                     json.dumps(srv.render_status(), indent=1) + "\n"
@@ -284,7 +376,10 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 ctype = "application/json"
                 code = 200
             else:
-                body = b"not found: serve /fleet/metrics, /fleet/status\n"
+                body = (
+                    b"not found: serve /fleet/metrics, /fleet/status, "
+                    b"/fleet/profile\n"
+                )
                 ctype = "text/plain; charset=utf-8"
                 code = 404
         except Exception as e:  # noqa: BLE001 — a scrape bug must answer
@@ -351,6 +446,16 @@ class FleetServer:
     def render_status(self) -> dict:
         meta = self._meta_provider() if self._meta_provider else None
         return fleet_status(self._scrape(), meta=meta)
+
+    def arm_profile(self, steps) -> dict:
+        """Fan /profile?steps=N out to every currently-resolvable child
+        (one call arms the whole group's lockstep window)."""
+        return arm_fleet_profile(
+            self._targets_provider(), steps, timeout_s=self.scrape_timeout_s
+        )
+
+    def render_profile_windows(self) -> list[dict]:
+        return profile_windows(self._scrape())
 
     def close(self) -> None:
         httpd, self._httpd = self._httpd, None

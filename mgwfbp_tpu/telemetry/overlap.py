@@ -56,6 +56,13 @@ class GroupOverlap:
     # reduce-scatter leg's (step-anchored) start. Zero on in-step rows.
     ag_start_s: float = 0.0
     ag_s: float = 0.0
+    # hierarchical (hier) only: the group's comm split by LINK — ici_s is
+    # the inner reduce-scatter + all-gather legs, dcn_s this group's share
+    # of its DCN group's cross-slice collective. comm_s = ici_s + dcn_s;
+    # the split is what tells an operator WHICH interconnect is the
+    # bottleneck. Zero on flat rows.
+    ici_s: float = 0.0
+    dcn_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,9 +114,27 @@ class OverlapSummary:
         fwd = max(self.fwd_end_s, self.tf_total_s)
         return max(fwd + self.tb_total_s, last_comm)
 
+    @property
+    def ici_s(self) -> float:
+        return sum(g.ici_s for g in self.groups)
+
+    @property
+    def dcn_s(self) -> float:
+        return sum(g.dcn_s for g in self.groups)
+
+    @property
+    def bottleneck_link(self) -> Optional[str]:
+        """'ici' or 'dcn' — the link carrying the larger comm share of a
+        hierarchical regime (None on flat regimes, where only one link
+        exists). The drift detector and the fleet console read this to
+        name WHICH wire to blame before anyone re-autotunes."""
+        if self.dcn_s <= 0.0:
+            return None
+        return "dcn" if self.dcn_s >= self.ici_s else "ici"
+
     def to_event_fields(self) -> dict:
         """The aggregate `overlap` telemetry record's payload."""
-        return {
+        out = {
             "step_s": float(self.step_s),
             "tb_total_s": float(self.tb_total_s),
             "tf_total_s": float(self.tf_total_s),
@@ -122,6 +147,11 @@ class OverlapSummary:
             "timeline_end_s": float(self.timeline_end_s),
             "num_groups": len(self.groups),
         }
+        if self.dcn_s > 0.0:
+            out["ici_s"] = float(self.ici_s)
+            out["dcn_s"] = float(self.dcn_s)
+            out["bottleneck_link"] = self.bottleneck_link
+        return out
 
     def group_event_fields(self, step: int) -> list[dict]:
         """One `comm_group` telemetry record payload per merge group
@@ -141,6 +171,9 @@ class OverlapSummary:
             if g.ag_s > 0.0:
                 fields["ag_start_s"] = float(g.ag_start_s)
                 fields["ag_s"] = float(g.ag_s)
+            if g.dcn_s > 0.0:
+                fields["ici_s"] = float(g.ici_s)
+                fields["dcn_s"] = float(g.dcn_s)
             out.append(fields)
         return out
 
@@ -256,6 +289,90 @@ def attribute_overlap_cross_step(
     return out, fwd_end
 
 
+def attribute_overlap_two_level(
+    groups: Sequence[Sequence[int]],
+    dcn_groups: Sequence[Sequence[int]],
+    tb: Sequence[float],
+    rs_s: Sequence[float],
+    dcn_s: Sequence[float],
+    ag_s: Sequence[float],
+    nbytes: Sequence[int],
+) -> list[GroupOverlap]:
+    """The hierarchical (hier) replay: two serial links race the backward
+    (`solver.simulate_groups_two_level`'s recurrence). Per inner group the
+    ICI link carries its reduce-scatter (taoc recurrence) and — after the
+    RS queue drains and its DCN group's cross-slice collective lands —
+    its all-gather; the DCN link carries one collective per DCN group
+    (`dcn_s`, one entry per DCN group), whose time and hidden share are
+    apportioned to member groups by payload. hidden = time inside the
+    backward window on EITHER link; the per-row ici_s/dcn_s split is what
+    names the bottleneck link."""
+    n = len(groups)
+    if any(len(x) != n for x in (rs_s, ag_s, nbytes)):
+        raise ValueError(
+            f"groups/rs_s/ag_s/nbytes disagree: {n}/{len(rs_s)}/"
+            f"{len(ag_s)}/{len(nbytes)}"
+        )
+    if len(dcn_s) != len(dcn_groups):
+        raise ValueError(
+            f"dcn_groups/dcn_s disagree: {len(dcn_groups)}/{len(dcn_s)}"
+        )
+    ready = np.cumsum(np.asarray(tb, dtype=np.float64))
+    bwd_end = float(ready[-1]) if len(ready) else 0.0
+
+    def hidden_in_bwd(start: float, dur: float) -> float:
+        return min(max(bwd_end - start, 0.0), dur)
+
+    # ICI link, RS phase
+    ici_free = 0.0
+    rs_start = [0.0] * n
+    rs_done = [0.0] * n
+    for gi, g in enumerate(groups):
+        start = max(ici_free, float(ready[max(g)]) if len(g) else 0.0)
+        rs_start[gi] = start
+        ici_free = start + float(rs_s[gi])
+        rs_done[gi] = ici_free
+    # DCN link: apportion each DCN collective to its members by payload
+    dcn_free = 0.0
+    dcn_done = [0.0] * n
+    g_dcn = [0.0] * n
+    g_dcn_hidden = [0.0] * n
+    for di, d in enumerate(dcn_groups):
+        t = float(dcn_s[di])
+        start = max(dcn_free, max(rs_done[gi] for gi in d))
+        dcn_free = start + t
+        hidden = hidden_in_bwd(start, t)
+        total_b = float(sum(nbytes[gi] for gi in d)) or 1.0
+        for gi in d:
+            share = float(nbytes[gi]) / total_b
+            dcn_done[gi] = dcn_free
+            g_dcn[gi] = t * share
+            g_dcn_hidden[gi] = hidden * share
+    # ICI link, AG phase
+    out: list[GroupOverlap] = []
+    for gi in range(n):
+        start = max(ici_free, dcn_done[gi])
+        t_ag = float(ag_s[gi])
+        ici_free = start + t_ag
+        hidden = (
+            hidden_in_bwd(rs_start[gi], float(rs_s[gi]))
+            + g_dcn_hidden[gi]
+            + hidden_in_bwd(start, t_ag)
+        )
+        comm = float(rs_s[gi]) + g_dcn[gi] + t_ag
+        out.append(GroupOverlap(
+            group=gi,
+            nbytes=int(nbytes[gi]),
+            start_s=rs_start[gi],
+            comm_s=comm,
+            hidden_s=hidden,
+            exposed_s=comm - hidden,
+            ici_s=float(rs_s[gi]) + t_ag,
+            dcn_s=g_dcn[gi],
+        ))
+    return out
+
+
 def group_comm_times(
     reducer,
     cost_model,
@@ -306,7 +423,61 @@ def summarize(
     comm, nbytes, attribution = group_comm_times(
         reducer, cost_model, measured
     )
-    if getattr(reducer, "comm_op", "all_reduce") == "rs_fwd_ag":
+    comm_op = getattr(reducer, "comm_op", "all_reduce")
+    if comm_op == "hier":
+        from mgwfbp_tpu.parallel.solver import (
+            is_two_level,
+            singleton_dcn_groups,
+            two_level_leg_costs,
+        )
+
+        dcn_part = [
+            list(d) for d in getattr(reducer.schedule, "dcn_groups", ())
+        ] or singleton_dcn_groups(len(nbytes))
+        if is_two_level(cost_model):
+            rs_c, dcn_c, ag_c = two_level_leg_costs(cost_model)
+        else:
+            # a flat model cannot split the links; put everything on the
+            # ICI side so the replay still runs (dcn_s = 0 marks the
+            # split as unavailable rather than inventing one)
+            rs_c = lambda b: 0.5 * float(cost_model.predict(b))  # noqa: E731
+            ag_c = lambda b: 0.5 * float(cost_model.predict(b))  # noqa: E731
+            dcn_c = lambda b: 0.0  # noqa: E731
+        # Per-link pricing. The DCN link runs ONE collective per DCN
+        # group over the members' concatenated shards — its cost is
+        # dcn_c(sum of member bytes), exactly once (summing per-member
+        # predictions would charge the DCN alpha per member, the very
+        # overhead merging on DCN exists to avoid — and precisely in the
+        # merged regime this accounting describes). ICI legs: TRACE
+        # totals sum the mgwfbp_groupNNNN scopes only — the ICI legs
+        # (the DCN collectives live under their own mgwfbp_dcngroupNNNN
+        # scopes, which per-group attribution does not yet collect) — so
+        # a measured t splits across the ICI legs and the DCN leg stays
+        # model-priced; without a trace the leg costs price directly.
+        dcn_s = [
+            float(dcn_c(float(sum(nbytes[gi] for gi in d))))
+            for d in dcn_part
+        ]
+        rs_s, ag_s = [], []
+        for t, b in zip(comm, nbytes):
+            r, a = rs_c(b), ag_c(b)
+            if attribution == "trace":
+                tot = max(r + a, 1e-30)
+                rs_s.append(t * r / tot)
+                ag_s.append(t * a / tot)
+            else:
+                rs_s.append(float(r))
+                ag_s.append(float(a))
+        rows = attribute_overlap_two_level(
+            reducer.layout.groups, dcn_part, tb, rs_s, dcn_s, ag_s, nbytes
+        )
+        return OverlapSummary(
+            step_s=float(step_s),
+            tb_total_s=float(sum(float(t) for t in tb)),
+            groups=tuple(rows),
+            attribution=attribution,
+        )
+    if comm_op == "rs_fwd_ag":
         from mgwfbp_tpu.parallel.solver import (
             cross_step_phase_costs,
             forward_prior_tf,

@@ -917,9 +917,10 @@ class Trainer:
             cost_model = getattr(self, "cost_model", None)
             predicted = None
             if cost_model is not None:
-                from mgwfbp_tpu.telemetry import group_comm_times
-
-                predicted, _, _ = group_comm_times(self.reducer, cost_model)
+                # device_s comes from group-scope attribution, so the
+                # predicted column must be scope-comparable (ICI legs
+                # only on hier — see _scope_comparable_predictions)
+                predicted = self._scope_comparable_predictions(cost_model)
             for gi in range(num_groups):
                 row = {
                     "group": gi,
@@ -987,6 +988,31 @@ class Trainer:
             ) if measured is not None else "",
         )
 
+    def _scope_comparable_predictions(self, cost_model):
+        """Per-group predicted seconds COMPARABLE to group-scope
+        (``mgwfbp_groupNNNN``) trace attribution. On the hier lowering
+        the DCN collectives live under their own ``mgwfbp_dcngroupNNNN``
+        scopes, which per-group attribution does not collect — so the
+        comparable prediction is the ICI legs (RS + AG) alone; a
+        full-predict comparison there raises a comm_residual alarm of
+        ~(ici+dcn)/ici on a perfectly calibrated model (and, with
+        MGWFBP_DRIFT_REAUTOTUNE=1, an endless forced re-race loop).
+        Every other lowering's group scopes cover the whole collective,
+        so the plain group_comm_times predictions apply."""
+        from mgwfbp_tpu.telemetry import group_comm_times
+
+        predicted, nbytes, _ = group_comm_times(self.reducer, cost_model)
+        if self.reducer.comm_op == "hier":
+            from mgwfbp_tpu.parallel.solver import (
+                is_two_level,
+                two_level_leg_costs,
+            )
+
+            if is_two_level(cost_model):
+                rs_c, _, ag_c = two_level_leg_costs(cost_model)
+                predicted = [rs_c(b) + ag_c(b) for b in nbytes]
+        return predicted
+
     def _on_watchdog_stall(
         self, phase: str, idle_s: float, timeout_s: float, abort: bool
     ) -> None:
@@ -1037,11 +1063,21 @@ class Trainer:
         if self.reducer is not None and cost_model is not None:
             from mgwfbp_tpu.telemetry import group_comm_times
 
-            predicted, _, _ = group_comm_times(self.reducer, cost_model)
             measured = self._measured_group_times
-            if measured is not None and len(measured) == len(predicted):
+            if measured is not None and len(measured) == (
+                self.reducer.layout.num_groups
+            ):
+                # measured is group-scope trace attribution: compare it
+                # against scope-COMPARABLE predictions (on hier the DCN
+                # collectives ride their own scopes and are not in it)
+                predicted = self._scope_comparable_predictions(cost_model)
                 alarms += det.observe_comm(predicted, measured_s=measured)
             elif self._tb_cache is not None:
+                # whole-step fallback: the full (both-link) predictions
+                # are the right comparison for a step-delta aggregate
+                predicted, _, _ = group_comm_times(
+                    self.reducer, cost_model
+                )
                 # aggregate upper bound: the non-backward share of the
                 # measured step (the autotune step-delta attribution) —
                 # needs a MEASURED tb (the size-prior tb is itself a comm
@@ -1178,6 +1214,7 @@ class Trainer:
             comm_dtype=cfg.comm_dtype,
             compressor=cfg.compressor, density=cfg.density,
             batch_size=cfg.batch_size, nsteps_update=cfg.nsteps_update,
+            dcn_slices=self.dcn_size,
         )
         path = at.entry_path(cache_dir, key)
         try:
@@ -1292,6 +1329,10 @@ class Trainer:
                     tuple(tuple(int(i) for i in g) for g in entry["groups"]),
                     entry["comm_op"],
                     detail=f"schedule-cache:{entry.get('winner', 'winner')}",
+                    dcn_groups=tuple(
+                        tuple(int(i) for i in d)
+                        for d in entry.get("dcn_groups") or ()
+                    ) or None,
                 )
             except Exception as e:  # noqa: BLE001 — a stale/corrupt entry
                 # must degrade to the solved schedule, not kill the resize
@@ -1397,6 +1438,7 @@ class Trainer:
             comm_dtype=cfg.comm_dtype,
             compressor=cfg.compressor, density=cfg.density,
             batch_size=cfg.batch_size, nsteps_update=cfg.nsteps_update,
+            dcn_slices=self.dcn_size,
         )
         path = at.entry_path(cache_dir, key)
         entry = at.load_cache_entry(path)
@@ -1414,10 +1456,17 @@ class Trainer:
             cache_hit = coord.agree_all(cache_hit)
         if cache_hit:
             groups = tuple(tuple(int(i) for i in g) for g in entry["groups"])
-            if not self._reducer_is_live(groups, entry["comm_op"]):
+            entry_dcn = tuple(
+                tuple(int(i) for i in d)
+                for d in entry.get("dcn_groups") or ()
+            ) or None
+            if not self._reducer_is_live(
+                groups, entry["comm_op"], entry_dcn
+            ):
                 self._swap_reducer(self._reducer_for(
                     groups, entry["comm_op"],
                     detail=f"autotune-cache:{entry.get('winner', 'winner')}",
+                    dcn_groups=entry_dcn,
                 ))
             self.log.info(
                 "autotune: cache hit %s — committed schedule loaded "
@@ -1439,6 +1488,7 @@ class Trainer:
                 "source": "cache", "cache_path": path,
                 "comm_op": entry["comm_op"],
                 "groups": [list(g) for g in groups],
+                "dcn_groups": [list(d) for d in entry_dcn or ()],
                 "winner": entry.get("winner"),
             }
             return self.autotune_report
@@ -1469,13 +1519,21 @@ class Trainer:
         comm_ops = (
             ("all_reduce",)
             if self._compressor is not None
-            else at.allowed_comm_ops(cfg.comm_op)
+            # hier candidates need the (ici, dcn) mesh — and not the seq
+            # axis, which the hier lowering does not compose with yet
+            else at.allowed_comm_ops(
+                cfg.comm_op,
+                multi_slice=self.dcn_size > 1 and self.seq_axis is None,
+            )
         )
         candidates = at.build_candidates(
             specs, tb, cost_model, comm_ops,
             tf=tf,
             max_candidates=max(int(cfg.autotune_candidates), 1),
-            incumbent=(self.reducer.schedule.groups, cfg.comm_op),
+            incumbent=(
+                self.reducer.schedule.groups, cfg.comm_op,
+                self.reducer.schedule.dcn_groups,
+            ),
         )
         steps = int(
             steps_per_candidate
@@ -1510,8 +1568,14 @@ class Trainer:
             # shape: the refit re-solve emits pre-layout groups, and on
             # dtype-mixed models the two differ — deduping on only one
             # side would re-race an already-timed schedule
-            raced_shapes.add((c.comm_op, tuple(map(tuple, c.groups))))
-            raced_shapes.add((e.comm_op, tuple(map(tuple, e.groups))))
+            raced_shapes.add((
+                c.comm_op, tuple(map(tuple, c.groups)),
+                tuple(map(tuple, c.dcn_groups)),
+            ))
+            raced_shapes.add((
+                e.comm_op, tuple(map(tuple, e.groups)),
+                tuple(map(tuple, e.dcn_groups)),
+            ))
         # multi-host: per-process wall clocks disagree; reduce every
         # candidate's timing to the group-agreed value (its slowest
         # process) BEFORE anything downstream reads them, so the refit
@@ -1524,10 +1588,13 @@ class Trainer:
         timed = [e for e in entries if e.measured_step_s is not None]
         if timed and cost_model is not None:
             best = min(timed, key=lambda e: e.measured_step_s)
-            if not self._reducer_is_live(best.groups, best.comm_op):
+            if not self._reducer_is_live(
+                best.groups, best.comm_op, best.dcn_groups or None
+            ):
                 self._swap_reducer(self._reducer_for(
                     best.groups, best.comm_op,
                     detail=f"autotune:{best.label}",
+                    dcn_groups=best.dcn_groups or None,
                 ))
             total_bytes = float(sum(s.nbytes for s in specs))
             obs, obs_source, measured_groups = self._group_observations(
@@ -1540,12 +1607,47 @@ class Trainer:
             traced_schedule = (
                 self.reducer.comm_op,
                 tuple(map(tuple, self.reducer.layout.groups)),
+                tuple(map(tuple, self.reducer.schedule.dcn_groups)),
             )
             if len(obs) >= 2:
+                from mgwfbp_tpu.parallel.solver import (
+                    is_two_level as _is_two_level,
+                )
+
                 try:
-                    new_model = refit_from_observations(
-                        cost_model, obs, cfg.comm_op
-                    )
+                    if _is_two_level(cost_model):
+                        # a two-level model must stay two-level: the flat
+                        # refit would silently collapse the per-link
+                        # constants into one line and unsolve the nested
+                        # schedule. Whether TRACE observations are
+                        # ICI-only depends on the lowering the trace ran
+                        # over (the LIVE reducer, not the model's type):
+                        # the hier lowering keeps its DCN collectives
+                        # under their own mgwfbp_dcngroupNNNN scopes, so
+                        # its group-scoped times are the ICI legs alone
+                        # and refit the ICI link; a FLAT lowering's one
+                        # scoped pmean crosses BOTH axes, so its times —
+                        # like step deltas — are whole-collective and
+                        # rescale both links by the common drift factor.
+                        from mgwfbp_tpu.parallel.costmodel import (
+                            refit_two_level_from_observations,
+                        )
+
+                        if (
+                            obs_source == "trace"
+                            and self.reducer.comm_op == "hier"
+                        ):
+                            new_model = refit_two_level_from_observations(
+                                cost_model, [], ici_observations=obs,
+                            )
+                        else:
+                            new_model = refit_two_level_from_observations(
+                                cost_model, obs
+                            )
+                    else:
+                        new_model = refit_from_observations(
+                            cost_model, obs, cfg.comm_op
+                        )
                 except ValueError as e:
                     self.log.info("autotune: refit skipped (%s)", e)
                 else:
@@ -1563,7 +1665,10 @@ class Trainer:
                         cost_model=new_model, comm_op=cfg.comm_op,
                     )
                     shape = tuple(tuple(g) for g in resolved.groups)
-                    if (cfg.comm_op, shape) not in raced_shapes:
+                    dcn_shape = tuple(
+                        tuple(d) for d in resolved.dcn_groups
+                    )
+                    if (cfg.comm_op, shape, dcn_shape) not in raced_shapes:
                         cand = at.Candidate(
                             label=(
                                 f"{cfg.comm_op}:refit->"
@@ -1574,6 +1679,7 @@ class Trainer:
                             predicted_total_s=float(
                                 resolved.predicted_total_time
                             ),
+                            dcn_groups=dcn_shape,
                         )
                         entries.append(self._race_candidate(
                             cand, batch_iter, sample_batch, steps
@@ -1601,13 +1707,17 @@ class Trainer:
             return self.autotune_report
         winner = min(timed, key=lambda e: e.measured_step_s)
         if measured_groups is not None and traced_schedule != (
-            winner.comm_op, tuple(map(tuple, winner.groups))
+            winner.comm_op, tuple(map(tuple, winner.groups)),
+            tuple(map(tuple, winner.dcn_groups)),
         ):
             measured_groups = None  # traced a different schedule's groups
-        if not self._reducer_is_live(winner.groups, winner.comm_op):
+        if not self._reducer_is_live(
+            winner.groups, winner.comm_op, winner.dcn_groups or None
+        ):
             self._swap_reducer(self._reducer_for(
                 winner.groups, winner.comm_op,
                 detail=f"autotune:{winner.label}",
+                dcn_groups=winner.dcn_groups or None,
             ))
         cache_entry = {
             "key": key,
@@ -1618,6 +1728,10 @@ class Trainer:
             "layer_names": names_now,
             "winner": winner.label,
             "groups": [list(g) for g in winner.groups],
+            # hier winners round-trip their nested DCN partition too; []
+            # for flat lowerings (and old entries load as one outer
+            # collective per group)
+            "dcn_groups": [list(d) for d in winner.dcn_groups],
             "measured_step_s": winner.measured_step_s,
             "tb_source": (
                 getattr(self._tb_cache, "source", "volume-prior")
@@ -1663,8 +1777,8 @@ class Trainer:
             **{
                 k: cache_entry[k]
                 for k in (
-                    "winner", "groups", "comm_op", "measured_step_s",
-                    "race", "refit",
+                    "winner", "groups", "dcn_groups", "comm_op",
+                    "measured_step_s", "race", "refit",
                 )
             },
         }
@@ -1689,10 +1803,14 @@ class Trainer:
             idx, entries[idx].label,
         )
 
-    def _reducer_for(self, groups, comm_op: str, detail: str = ""):
+    def _reducer_for(
+        self, groups, comm_op: str, detail: str = "", dcn_groups=None,
+    ):
         """A MergedAllreduce for an EXPLICIT grouping (autotune candidates,
         cache hits), sharing the live cost model / tb / axes / compressor
-        wiring with `_build_reducer`."""
+        wiring with `_build_reducer`. For comm_op='hier', `dcn_groups` is
+        the candidate's nested DCN partition (None = one outer collective
+        per group)."""
         cfg = self.config
         axes = self.data_axes
         if self.seq_axis is not None:
@@ -1703,6 +1821,7 @@ class Trainer:
             axis_name=axes,
             policy="auto",  # only sets the tb fallback; `groups` wins
             groups=groups,
+            dcn_groups=dcn_groups if comm_op == "hier" else None,
             policy_detail=detail,
             tb=self._tb_cache,
             tf=self._tf_cache,
@@ -1718,16 +1837,30 @@ class Trainer:
             world_size=self.data_size * self.seq_size,
         )
 
-    def _reducer_is_live(self, groups, comm_op: str) -> bool:
+    def _reducer_is_live(self, groups, comm_op: str, dcn_groups=None) -> bool:
         """True when the live reducer already issues exactly this schedule
         — skipping the rebuild avoids the tuning phase's dominant cost (a
-        fresh XLA compile) plus a sharded opt-state round trip."""
+        fresh XLA compile) plus a sharded opt-state round trip. A hier
+        candidate must also match the live NESTED (DCN) partition: same
+        inner groups under a different outer merge is a different
+        program."""
         live = self.reducer
         shape = tuple(tuple(int(i) for i in g) for g in groups)
-        return comm_op == live.comm_op and shape in (
+        if comm_op != live.comm_op or shape not in (
             tuple(map(tuple, live.layout.groups)),
             tuple(map(tuple, live.schedule.groups)),
-        )
+        ):
+            return False
+        if comm_op == "hier" and dcn_groups is not None:
+            want = tuple(tuple(int(i) for i in d) for d in dcn_groups)
+            from mgwfbp_tpu.parallel.solver import singleton_dcn_groups
+
+            live_dcn = live.schedule.dcn_groups or tuple(
+                tuple(d) for d in singleton_dcn_groups(len(shape))
+            )
+            if want != live_dcn:
+                return False
+        return True
 
     def _swap_reducer(self, reducer) -> None:
         """Hot-swap the live merge schedule mid-run — the elastic-resize
@@ -1840,7 +1973,9 @@ class Trainer:
             predicted_total_s=None if pred != pred else pred,
             groups=cand.groups,
         )
-        is_live = self._reducer_is_live(cand.groups, cand.comm_op)
+        is_live = self._reducer_is_live(
+            cand.groups, cand.comm_op, cand.dcn_groups or None
+        )
         if is_live:
             # the incumbent is already installed, burned in, and compiled —
             # rebuilding it would waste the tuning phase's dominant cost
@@ -1851,6 +1986,7 @@ class Trainer:
                 reducer = self._reducer_for(
                     cand.groups, cand.comm_op,
                     detail=f"autotune:{cand.label}",
+                    dcn_groups=cand.dcn_groups or None,
                 )
             except Exception as e:  # noqa: BLE001 — a bad candidate must
                 # not take down the tuning phase; recorded and skipped
@@ -1862,6 +1998,7 @@ class Trainer:
         # build_layout may split dtype-mixed groups; race what is issued
         entry.groups = reducer.layout.groups
         entry.num_groups = reducer.layout.num_groups
+        entry.dcn_groups = reducer.schedule.dcn_groups
         wd = getattr(self, "_watchdog", None)
         if wd is not None:
             from mgwfbp_tpu.utils.watchdog import COMPILE_ALLOW_S

@@ -98,10 +98,12 @@ def format_report(records: list[dict]) -> str:
             f"{snap.get('attribution')}):"
         )
         cross = float(snap.get("tf_total_s", 0.0) or 0.0) > 0.0
+        hier = float(snap.get("dcn_s", 0.0) or 0.0) > 0.0
         lines.append(
             f"  {'group':>5} {'bytes':>12} {'comm_s':>10} {'hidden_s':>10} "
             f"{'exposed_s':>10}"
             + (f" {'ag_s':>10}" if cross else "")
+            + (f" {'ici_s':>10} {'dcn_s':>10}" if hier else "")
         )
         for r in rows:
             row = (
@@ -113,6 +115,12 @@ def format_report(records: list[dict]) -> str:
                 # cross-step regime: ag_s is the deferred all-gather leg
                 # riding the NEXT step's forward
                 row += f" {_fmt_s(r.get('ag_s', 0.0)):>10}"
+            if hier:
+                # hierarchical regime: each group's comm split by LINK
+                row += (
+                    f" {_fmt_s(r.get('ici_s', 0.0)):>10} "
+                    f"{_fmt_s(r.get('dcn_s', 0.0)):>10}"
+                )
             lines.append(row)
         tail = (
             f"(forward {_fmt_s(snap.get('tf_total_s'))} s, backward "
@@ -132,6 +140,13 @@ def format_report(records: list[dict]) -> str:
                 "  cross-step regime (rs_fwd_ag): each group's AG is "
                 "deferred into the next step's forward; hidden counts "
                 "both forward- and backward-side overlap"
+            )
+        if hier:
+            lines.append(
+                "  hierarchical regime (hier): comm split by link — ici "
+                f"{_fmt_s(snap.get('ici_s'))} s vs dcn "
+                f"{_fmt_s(snap.get('dcn_s'))} s; bottleneck link: "
+                f"{snap.get('bottleneck_link')}"
             )
         lines.append(
             f"overlap efficiency: {float(snap.get('efficiency', 0.0)):.4f} "

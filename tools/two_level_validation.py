@@ -1,28 +1,27 @@
 """Validate the two-level (ICI+DCN) cost model against measurement
-(VERDICT r4 #8).
+(VERDICT r4 #8; thin consumer of `profiling.profile_two_level` since the
+per-axis calibration moved there for `calibrate --two-level`).
 
-`costmodel.TwoLevelAlphaBeta` prices a hierarchical bucket all-reduce as
-ici(full payload) + dcn(payload / ici_size) — the reduce-scatter(inner) ->
-all-reduce(outer) -> all-gather(inner) lowering of
-`allreduce._hierarchical_allreduce`. Until now that model was only
-correctness-tested; this tool checks its PREDICTIONS on a mesh where both
-levels are real collectives: the virtual CPU mesh shaped (ici, dcn).
+Two checks on a mesh where both levels are real collectives — the virtual
+CPU mesh shaped (ici, dcn):
 
-Protocol:
-  1. Calibrate per-axis AlphaBeta by timing a pmean over ONLY the inner
-     axis and ONLY the outer axis, payload-swept (the per-axis analogue of
-     `profiling.profile_allreduce`).
-  2. Time the actual `hier` lowering and the flat both-axes pmean over the
-     same payloads.
-  3. Compare TwoLevelAlphaBeta predictions against the measured hier
-     times; record per-size gaps. Also record hier-vs-flat so the artifact
-     says when the explicit hierarchy beats XLA's flat lowering here.
+  1. COMPOSITION (the original r4 check): `costmodel.TwoLevelAlphaBeta`
+     prices a hierarchical bucket all-reduce as ici(full payload) +
+     dcn(payload / ici_size). Time the actual hier lowering and the flat
+     both-axes pmean over the calibration's payloads and record per-size
+     prediction gaps (raw and dispatch-corrected — the two standalone
+     phase sweeps carry two program dispatches, the fused program one).
+  2. SOLVED SCHEDULE (ISSUE 11): the two-link solver's output, not just a
+     single bucket. Solve a synthetic layer set with
+     `auto_groups_two_level` (nested inner/DCN partitions), lower it via
+     the real `make_merged_allreduce(comm_op='hier')`, and time it against
+     the flat single-link solve under the all_reduce lowering — the
+     hier-vs-flat race the autotuner runs live, measured offline.
 
 Caveat recorded in the artifact: on the virtual CPU mesh both "levels"
 are the same memory fabric, so ici/dcn constants differ only by group
-size/contention — the check validates the MODEL'S COMPOSITION (that
-hier cost = inner term on full payload + outer term on the shard), not
-real DCN physics.
+size/contention — the check validates the MODEL'S COMPOSITION and the
+SOLVER'S MACHINERY, not real DCN physics.
 
 Usage:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -50,28 +49,149 @@ def _time_fn(fn, x, warmup, iters):
     return (time.perf_counter() - t0) / iters
 
 
+def _solved_schedule_check(model, raw, warmup, iters):
+    """Race the SOLVED nested hier schedule against the flat single-link
+    solve, both lowered for real on the calibration mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from mgwfbp_tpu.parallel.allreduce import make_merged_allreduce
+    from mgwfbp_tpu.parallel.solver import (
+        auto_groups,
+        simulate_groups,
+        simulate_groups_two_level,
+        singleton_dcn_groups,
+        two_level_leg_costs,
+    )
+    from mgwfbp_tpu.utils.platform import get_shard_map
+
+    shard_map = get_shard_map()
+    mesh = raw["mesh"]
+    inner, outer = raw["inner_axis"], raw["outer_axis"]
+
+    # synthetic model: a dozen mixed-size layers, backward profile from
+    # the parameter-volume prior at a scale where merging decisions are
+    # live (the regime the win condition cares about)
+    rs = np.random.RandomState(0)
+    sizes = [int(s) for s in rs.choice(
+        [1 << 14, 1 << 16, 1 << 18], size=12
+    )]
+    tb_total = model.predict(float(sum(sizes)) * 4)
+    tb = [tb_total * s / sum(sizes) for s in sizes]
+    tree = {
+        f"layer{i:02d}": {"w": jnp.asarray(rs.randn(s), jnp.float32)}
+        for i, s in enumerate(sizes)
+    }
+    nbytes = [s * 4 for s in sizes]
+
+    hier_red = make_merged_allreduce(
+        tree, axis_name=(inner, outer), policy="auto", comm_op="hier",
+        tb=tb, cost_model=model,
+    )
+    flat_groups, flat_detail = auto_groups(
+        sizes, tb, alpha=model.alpha, cost=model.predict,
+    )
+    flat_red = make_merged_allreduce(
+        tree, axis_name=(inner, outer), policy="auto", comm_op="all_reduce",
+        tb=tb, cost_model=model, groups=flat_groups,
+        policy_detail=flat_detail,
+    )
+
+    def timed(red):
+        fn = jax.jit(shard_map(
+            lambda t: red(t), mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        ))
+        for _ in range(warmup):
+            jax.block_until_ready(fn(tree))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(tree)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    t_hier = timed(hier_red)
+    t_flat = timed(flat_red)
+    rs_c, dcn_c, ag_c = two_level_leg_costs(model)
+    pred_hier, _, _ = simulate_groups_two_level(
+        hier_red.schedule.groups, hier_red.schedule.dcn_groups, nbytes, tb,
+        rs_c, dcn_c, ag_c,
+    )
+    pred_flat, _, _ = simulate_groups(
+        flat_red.schedule.groups, nbytes, tb, model.predict,
+    )
+    pred_hier_singleton, _, _ = simulate_groups_two_level(
+        hier_red.schedule.groups,
+        singleton_dcn_groups(len(hier_red.schedule.groups)),
+        nbytes, tb, rs_c, dcn_c, ag_c,
+    )
+    return {
+        "layer_sizes": sizes,
+        "hier": {
+            "detail": hier_red.schedule.policy_detail,
+            "groups": [list(g) for g in hier_red.schedule.groups],
+            "dcn_groups": [list(d) for d in hier_red.schedule.dcn_groups],
+            "predicted_s": round(float(pred_hier), 6),
+            "predicted_singleton_dcn_s": round(
+                float(pred_hier_singleton), 6
+            ),
+            "measured_s": round(t_hier, 6),
+        },
+        "flat": {
+            "detail": flat_detail,
+            "groups": [list(g) for g in flat_red.schedule.groups],
+            "predicted_s": round(float(pred_flat), 6),
+            "measured_s": round(t_flat, 6),
+        },
+        "solved_hier_vs_flat_measured": round(t_hier / t_flat, 4),
+        "solved_hier_vs_flat_predicted": round(
+            float(pred_hier) / float(pred_flat), 4
+        ),
+    }
+
+
 def run(ici, dcn, min_log2, max_log2, warmup, iters):
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax import lax
-    from jax.sharding import Mesh
     from jax.sharding import PartitionSpec as P
 
     from mgwfbp_tpu.parallel.allreduce import _hierarchical_allreduce
-    from mgwfbp_tpu.parallel.costmodel import (
-        SampledCost, TwoLevelAlphaBeta, fit_alpha_beta,
-    )
+    from mgwfbp_tpu.parallel.costmodel import SampledCost, fit_alpha_beta
+    from mgwfbp_tpu.profiling import profile_two_level
     from mgwfbp_tpu.utils.platform import get_shard_map
 
     shard_map = get_shard_map()
 
-    n = ici * dcn
-    devs = np.asarray(jax.devices()[:n]).reshape(ici, dcn)
-    mesh = Mesh(devs, ("ici", "dcn"))
+    # step 1: per-axis calibration — the shared engine behind
+    # `calibrate --two-level` (this tool only CONSUMES it now)
     sizes = [2 ** k for k in range(min_log2, max_log2 + 1)]
-    itemsize = 4
+    model_sampled, raw = profile_two_level(
+        ici, dcn, sizes=sizes, warmup=warmup, iters=iters,
+        noop_baseline=True,  # the dispatch correction's baseline
+    )
+    mesh = raw["mesh"]
+    inner, outer = raw["inner_axis"], raw["outer_axis"]
+    t_ici = raw["ici_s"]
+    t_dcn = raw["dcn_s"]
+    t_id = raw["noop_s"]
+    nbytes = raw["sizes_bytes"]
+    ab_ici = model_sampled.ici.ab
+    ab_dcn = model_sampled.dcn.ab
+    from mgwfbp_tpu.parallel.costmodel import TwoLevelAlphaBeta
 
+    model = TwoLevelAlphaBeta(
+        ici=ab_ici, dcn=ab_dcn, ici_size=ici, dcn_size=dcn
+    )
+    sc_id = SampledCost(
+        tuple(nbytes), tuple(t_id[b] for b in nbytes),
+        ab=fit_alpha_beta(nbytes, [t_id[b] for b in nbytes]),
+    )
+
+    # step 2: measure the actual hier lowering + the flat both-axes pmean
     def timed(body):
         fn = jax.jit(
             shard_map(
@@ -80,57 +200,27 @@ def run(ici, dcn, min_log2, max_log2, warmup, iters):
             )
         )
         return {
-            s: _time_fn(fn, jnp.ones((s,), jnp.float32), warmup, iters)
-            for s in sizes
+            b: _time_fn(
+                fn, jnp.ones((b // 4,), jnp.float32), warmup, iters
+            )
+            for b in nbytes
         }
 
-    t_ici = timed(lambda x: lax.pmean(x, "ici"))
-    t_dcn = timed(lambda x: lax.pmean(x, "dcn"))
-    t_flat = timed(lambda x: lax.pmean(x, ("ici", "dcn")))
+    t_flat = timed(lambda x: lax.pmean(x, (inner, outer)))
     t_hier = timed(
-        lambda x: _hierarchical_allreduce(x, "ici", "dcn", mean=True)
-    )
-    # dispatch baseline: a jitted no-collective program over the same
-    # payload. Each standalone per-axis timing above bakes one program
-    # dispatch + output materialization into its curve; the fused hier
-    # program pays that once, so naive composition double-counts it (the
-    # production calibration separates this as gamma for the same reason).
-    t_id = timed(lambda x: x * 1.0)
-
-    nbytes = [s * itemsize for s in sizes]
-    ab_ici = fit_alpha_beta(nbytes, [t_ici[s] for s in sizes])
-    ab_dcn = fit_alpha_beta(nbytes, [t_dcn[s] for s in sizes])
-    model = TwoLevelAlphaBeta(
-        ici=ab_ici, dcn=ab_dcn, ici_size=ici, dcn_size=dcn
-    )
-    # the production-grade predictor: SampledCost curves per level (a
-    # single alpha-beta line cannot describe this mesh's cache-regime
-    # nonlinearity — same reason flat calibrations persist sampled
-    # curves). TwoLevelAlphaBeta composes by duck-typed .predict, so the
-    # sampled members exercise the same composition rule.
-    sc_ici = SampledCost(tuple(nbytes), tuple(t_ici[s] for s in sizes),
-                         ab=ab_ici)
-    sc_dcn = SampledCost(tuple(nbytes), tuple(t_dcn[s] for s in sizes),
-                         ab=ab_dcn)
-    sc_id = SampledCost(
-        tuple(nbytes), tuple(t_id[s] for s in sizes),
-        ab=fit_alpha_beta(nbytes, [t_id[s] for s in sizes]),
-    )
-    model_sampled = TwoLevelAlphaBeta(
-        ici=sc_ici, dcn=sc_dcn, ici_size=ici, dcn_size=dcn
+        lambda x: _hierarchical_allreduce(x, inner, outer, mean=True)
     )
 
     rows = []
     gaps_ab, gaps_sc, gaps_corr = [], [], []
-    for s in sizes:
-        b = s * itemsize
+    for b in nbytes:
         pred_ab = model.predict(b)
         pred_sc = model_sampled.predict(b)
         # dispatch-corrected composition: the two phase curves carry two
         # program dispatches, the fused program pays one — subtract the
         # smaller phase's no-op program time
         pred_corr = pred_sc - sc_id.predict(b / max(ici, 1))
-        meas = t_hier[s]
+        meas = t_hier[b]
         gap_ab = (pred_ab - meas) / meas
         gap_sc = (pred_sc - meas) / meas
         gap_corr = (pred_corr - meas) / meas
@@ -139,28 +229,31 @@ def run(ici, dcn, min_log2, max_log2, warmup, iters):
         gaps_corr.append(abs(gap_corr))
         rows.append({
             "payload_bytes": b,
-            "measured_ici_only_s": round(t_ici[s], 6),
-            "measured_dcn_only_s": round(t_dcn[s], 6),
-            "measured_noop_s": round(t_id[s], 6),
+            "measured_ici_only_s": round(t_ici[b], 6),
+            "measured_dcn_only_s": round(t_dcn[b], 6),
+            "measured_noop_s": round(t_id[b], 6),
             "measured_hier_s": round(meas, 6),
-            "measured_flat_s": round(t_flat[s], 6),
+            "measured_flat_s": round(t_flat[b], 6),
             "predicted_hier_ab_fit_s": round(pred_ab, 6),
             "predicted_hier_sampled_s": round(pred_sc, 6),
             "predicted_hier_dispatch_corrected_s": round(pred_corr, 6),
             "prediction_gap_ab_fit_frac": round(gap_ab, 4),
             "prediction_gap_sampled_frac": round(gap_sc, 4),
             "prediction_gap_corrected_frac": round(gap_corr, 4),
-            "hier_vs_flat": round(meas / t_flat[s], 4),
+            "hier_vs_flat": round(meas / t_flat[b], 4),
         })
-    return model, {
+
+    # step 3 (ISSUE 11): validate the SOLVED hier schedule, not just
+    # single-bucket composition — the two-link solver's nested output
+    # lowered for real and raced against the flat single-link solve
+    solved = _solved_schedule_check(model_sampled, raw, warmup, iters)
+
+    return model_sampled, {
         "mesh": {"ici": ici, "dcn": dcn},
         "device_kind": jax.devices()[0].device_kind,
         "warmup": warmup,
         "iters": iters,
-        "fit": {
-            "ici": {"alpha": ab_ici.alpha, "beta": ab_ici.beta},
-            "dcn": {"alpha": ab_dcn.alpha, "beta": ab_dcn.beta},
-        },
+        "fit": raw["fit"],
         "rows": rows,
         # the composition check proper: measured per-level curves composed
         # as ici(full) + dcn(shard), vs the measured hier lowering
@@ -178,11 +271,12 @@ def run(ici, dcn, min_log2, max_log2, warmup, iters):
         "median_hier_vs_flat": round(
             float(np.median([r["hier_vs_flat"] for r in rows])), 4
         ),
+        "solved_schedule": solved,
         "caveat": (
             "virtual CPU mesh: both levels share one memory fabric, so "
             "this validates the model's COMPOSITION (inner term on full "
-            "payload + outer term on the 1/ici_size shard), not DCN "
-            "physics"
+            "payload + outer term on the 1/ici_size shard) and the "
+            "two-link solver's machinery, not DCN physics"
         ),
         "finding": (
             "dispatch-corrected composition tracks the measured hier "
@@ -195,7 +289,9 @@ def run(ici, dcn, min_log2, max_log2, warmup, iters):
             "fabric. hier_vs_flat > 1 throughout: on a single-fabric mesh "
             "the explicit hierarchy only adds steps — consistent with the "
             "model, which prices hier above flat whenever the outer level "
-            "is not much slower than the inner"
+            "is not much slower than the inner; the solved_schedule "
+            "section measures the same ranking for the SOLVED nested "
+            "schedule, which is the live autotune race's offline twin"
         ),
     }
 
