@@ -86,6 +86,18 @@ def main(argv=None) -> int:
         findings.extend(verify_train_step(
             args.model, "wfbp", grad_guard=False,
         ))
+        # SCH010: the training-health statistics (ISSUE 12) must not
+        # change the step's collective footprint — stats-on and stats-off
+        # traces compared on the flat and the sharded-optimizer lowerings
+        # (the two distinct collective shapes)
+        from mgwfbp_tpu.analysis.jaxpr_check import (
+            verify_health_stats_footprint,
+        )
+
+        for comm_op in ("all_reduce", "rs_opt_ag"):
+            findings.extend(verify_health_stats_footprint(
+                args.model, "mgwfbp", comm_op=comm_op,
+            ))
 
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = sum(1 for f in findings if f.severity == WARNING)

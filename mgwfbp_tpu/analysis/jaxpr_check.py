@@ -37,7 +37,14 @@ asserts, against the `MergedAllreduce` that built it:
           concatenated shards at the wire dtype, the DCN partition
           covering every inner group exactly once, no cross-pod (outer-
           axis) collective anywhere else, and the DCN scope never
-          appearing on a non-hier path.
+          appearing on a non-hier path;
+  SCH010  the training-health statistics (ISSUE 12) are FREE at the
+          collective layer: tracing the same step with health_stats on
+          and off must yield identical collective footprints (same
+          collective primitives, same counts — the stats ride the
+          EXISTING metrics psum) and zero host callbacks either way. A
+          stats build that grows the footprint is a new collective (or a
+          host sync) smuggled into the hot path.
 """
 
 from __future__ import annotations
@@ -626,6 +633,82 @@ def verify_jaxpr_against_reducer(
     return out
 
 
+def collective_footprint(closed_jaxpr: Any) -> dict[str, int]:
+    """Collective/callback primitive counts of a traced program — the
+    SCH010 comparison unit. Counting by primitive NAME (not scope) makes
+    the footprint insensitive to where the stats sit in the program and
+    sensitive to exactly what the rule forbids: any additional
+    collective or host callback."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    counts: dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS or name in CALLBACK_PRIMS:
+            counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def compare_collective_footprints(
+    base: Any,
+    stats: Any,
+    *,
+    file: str = "<health-stats trace>",
+) -> list[Finding]:
+    """SCH010: the stats-on program's collective footprint must equal the
+    stats-off program's, and neither may carry a host callback. `base`
+    and `stats` are the two traced programs (`jax.make_jaxpr` output)."""
+    out: list[Finding] = []
+
+    def add(rule_id: str, msg: str) -> None:
+        out.append(Finding(file, 0, rule_id, msg))
+
+    fp_base = collective_footprint(base)
+    fp_stats = collective_footprint(stats)
+    for prim in sorted(set(fp_base) | set(fp_stats)):
+        b, s = fp_base.get(prim, 0), fp_stats.get(prim, 0)
+        if prim in CALLBACK_PRIMS:
+            if s or b:
+                add("SCH005",
+                    f"host callback '{prim}' in the hot path "
+                    f"(stats-off x{b}, stats-on x{s})")
+            continue
+        if s > b:
+            add("SCH010",
+                f"health statistics added {s - b} '{prim}' "
+                f"collective(s) ({b} -> {s}) — the stats must ride the "
+                "EXISTING metrics psum, not new collectives")
+        elif s < b:
+            add("SCH010",
+                f"health statistics REMOVED {b - s} '{prim}' "
+                f"collective(s) ({b} -> {s}) — the stats build no longer "
+                "realizes the same schedule as the plain step")
+    return out
+
+
+def verify_health_stats_footprint(
+    model_name: str = "lenet",
+    policy: str = "mgwfbp",
+    *,
+    comm_op: str = "all_reduce",
+) -> list[Finding]:
+    """Trace one representative step with health statistics off and on
+    and apply SCH010. The rs_fwd_ag lowering compares its two-step
+    programs (the deferred gathers live across the boundary)."""
+    kw: dict[str, Any] = dict(comm_op=comm_op)
+    if comm_op in ("rs_opt_ag", "rs_fwd_ag"):
+        kw["norm_clip"] = 1.0
+    if comm_op == "rs_fwd_ag":
+        kw["steps"] = 2
+    base, _, _ = trace_train_step(model_name, policy, **kw)
+    stats, _, _ = trace_train_step(
+        model_name, policy, health_stats=True, **kw
+    )
+    return compare_collective_footprints(
+        base, stats,
+        file=f"<health-stats {model_name}/{policy}/{comm_op}>",
+    )
+
+
 # ---------------------------------------------------------------------------
 # Self-contained verification target: build a representative train step and
 # check it. Used by the CLI and by the analyzer's own clean-on-HEAD test.
@@ -659,8 +742,13 @@ def trace_train_step(
     steps: int = 1,
     dcn_slices: Optional[int] = None,
     dcn_groups: Optional[Any] = None,
+    health_stats: bool = False,
 ) -> tuple[Any, Any, list]:
     """Build and trace a representative jitted MG-WFBP train step.
+
+    health_stats traces the ISSUE-12 training-health-statistics build —
+    `verify_health_stats_footprint` compares it against the plain trace
+    (rule SCH010: the stats may not change the collective footprint).
 
     Returns (closed_jaxpr, reducer, grad_leaves_in_arrival_order) — the
     exact inputs `verify_jaxpr_against_reducer` wants. Tracing only: state
@@ -752,7 +840,7 @@ def trace_train_step(
         state = state.replace(params=reducer.optim.params_struct())
     step = make_train_step(
         model, meta, tx, mesh, reducer, axis_name=axis_name,
-        donate=donate, grad_guard=grad_guard,
+        donate=donate, grad_guard=grad_guard, health_stats=health_stats,
     )
     batch = {
         "x": jax.ShapeDtypeStruct(

@@ -94,6 +94,14 @@ _register("SCH007", ERROR,
 _register("SCH008", ERROR,
           "non-finite-gradient guard presence differs from the step's "
           "configuration (is_finite check missing, or present when disabled)")
+_register("SCH009", ERROR,
+          "hierarchical (hier) nested-schedule contract violated: inner "
+          "RS/AG leg shape, DCN-group collective count/payload/dtype, or "
+          "a cross-pod collective outside its declared scope")
+_register("SCH010", ERROR,
+          "training-health statistics changed the step's collective "
+          "footprint (the stats must ride the EXISTING metrics psum — "
+          "zero new collectives or host callbacks)")
 
 
 _NOQA = re.compile(r"#\s*graft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s]+)\])?")

@@ -106,6 +106,12 @@ class TrainConfig:
     # always writes one regardless, so preemption loses at most one step
     grad_guard: bool = True  # non-finite-gradient guard in the jitted step:
     # drop the update on NaN/inf grads (bad_step telemetry, zero host syncs)
+    health_stats: bool = True  # in-jit training-health statistics (ISSUE
+    # 12): per-merge-group grad L2 norms + update/param ratio riding the
+    # EXISTING metrics psum (no extra collectives — jaxpr rule SCH010);
+    # effective only with telemetry on (the stats exist to be streamed —
+    # `health` records, the online detector in telemetry/health.py, the
+    # flight recorder; without the stream the step compiles without them)
     bad_step_limit: int = 3  # consecutive bad steps before rolling back to
     # the last checkpoint (0 disables rollback; skipping still applies)
     pretrain: Optional[str] = None

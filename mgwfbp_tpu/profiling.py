@@ -941,15 +941,23 @@ def hlo_collective_scope_map(
 
 def _group_times_from_scopes(
     rows: Sequence[tuple[str, float]], num_groups: int, iters: int,
+    scope_name=None,
 ) -> Optional[list[float]]:
     """The direct name-stack attribution: each group's time is the sum of
     the event durations whose identifier carries its scope, averaged over
-    the traced steps (real TPU op metadata keeps the scope)."""
-    from mgwfbp_tpu.parallel.allreduce import group_scope_name
+    the traced steps (real TPU op metadata keeps the scope).
 
+    ``scope_name`` maps a group index to its scope label; the default is
+    the merge-group scope, and the hier lowering's DCN legs attribute by
+    passing `allreduce.dcn_group_scope_name` instead (the per-link refit
+    path — the two scope families never collide textually)."""
+    if scope_name is None:
+        from mgwfbp_tpu.parallel.allreduce import group_scope_name
+
+        scope_name = group_scope_name
     out: list[float] = []
     for gi in range(num_groups):
-        tag = group_scope_name(gi)
+        tag = scope_name(gi)
         dur_us = sum(dur for ident, dur in rows if tag in ident)
         if dur_us <= 0.0:
             return None  # partial attribution is worse than none
@@ -961,6 +969,8 @@ def _group_times_from_hlo_join(
     rows: Sequence[tuple[str, float]],
     num_groups: int,
     hlo_text: str,
+    tag: str = "mgwfbp_group",
+    scope_name=None,
 ) -> Optional[list[float]]:
     """Attribution fallback via the compiled-HLO join
     (`hlo_collective_scope_map`): trace events are matched by HLO
@@ -969,10 +979,18 @@ def _group_times_from_hlo_join(
     the mean normalizes over both `iters` and device multiplicity —
     unlike the scope path, whose per-device traces carry only local
     events). A group's time is the sum over its instructions (rs/ag legs
-    count once each). Returns None when any group attributes nothing."""
-    from mgwfbp_tpu.parallel.allreduce import group_scope_name
+    count once each). Returns None when any group attributes nothing.
 
-    scope_map = hlo_collective_scope_map(hlo_text)
+    ``tag``/``scope_name`` parameterize the scope family, exactly like
+    `_group_times_from_scopes` — the hier DCN legs join on
+    ``mgwfbp_dcngroup`` (which ``mgwfbp_group``'s regex cannot match:
+    the prefix character before 'group' differs)."""
+    if scope_name is None:
+        from mgwfbp_tpu.parallel.allreduce import group_scope_name
+
+        scope_name = group_scope_name
+
+    scope_map = hlo_collective_scope_map(hlo_text, tag=tag)
     if not scope_map:
         return None
     per_instr: dict[str, tuple[float, int]] = {}
@@ -983,11 +1001,11 @@ def _group_times_from_hlo_join(
             per_instr[name] = (t + dur, c + 1)
     out: list[float] = []
     for gi in range(num_groups):
-        tag = group_scope_name(gi)
+        want = scope_name(gi)
         total_us = 0.0
         found = False
         for name, sc in scope_map.items():
-            if sc != tag or name not in per_instr:
+            if sc != want or name not in per_instr:
                 continue
             t, c = per_instr[name]
             total_us += t / max(c, 1)
@@ -1033,6 +1051,72 @@ def trace_group_times(
     out = _group_times_from_scopes(rows, num_groups, iters)
     if out is None and hlo_text:
         out = _group_times_from_hlo_join(rows, num_groups, hlo_text)
+    return out
+
+
+def trace_two_level_group_times(
+    run_steps: Callable[[], None],
+    num_groups: int,
+    num_dcn_groups: int,
+    iters: int = 1,
+    logdir: Optional[str] = None,
+    hlo_text: Optional[str] = None,
+) -> tuple[Optional[list[float]], Optional[list[float]]]:
+    """Per-LINK trace attribution of a hier schedule (ROADMAP hier
+    follow-up b): ONE profiler trace, split two ways — the
+    ``mgwfbp_groupNNNN`` scopes time each bucket's ICI legs (RS + AG),
+    the ``mgwfbp_dcngroupNNNN`` scopes its DCN collective. Returns
+    ``(ici_times, dcn_times)`` in arrival / DCN-partition order (seconds
+    per step), either side None when its scopes attribute nothing —
+    the autotuner then falls back exactly as `trace_group_times` does.
+
+    This is what lets `costmodel.refit_two_level_from_observations`
+    refit a drifted DCN link ALONE (its `dcn_observations` input)
+    instead of smearing a whole-step drift factor over both links."""
+    from mgwfbp_tpu.parallel.allreduce import dcn_group_scope_name
+
+    rows = _with_trace_events(
+        run_steps, logdir, prefix="mgwfbp_group_trace_"
+    )
+    if not rows:
+        return None, None
+    ici = _group_times_from_scopes(rows, num_groups, iters)
+    dcn = _group_times_from_scopes(
+        rows, num_dcn_groups, iters, scope_name=dcn_group_scope_name
+    )
+    if hlo_text:
+        if ici is None:
+            ici = _group_times_from_hlo_join(rows, num_groups, hlo_text)
+        if dcn is None:
+            dcn = _group_times_from_hlo_join(
+                rows, num_dcn_groups, hlo_text,
+                tag="mgwfbp_dcngroup", scope_name=dcn_group_scope_name,
+            )
+    return ici, dcn
+
+
+def dcn_shard_nbytes(
+    layout: Any,
+    dcn_groups: Sequence[Sequence[int]],
+    ici_size: int,
+    comm_dtype: Optional[Any] = None,
+) -> list[int]:
+    """Per-DCN-group OUTER-wire payload bytes: the sum of the members'
+    padded 1/ici_size bucket shards — exactly the concatenated payload
+    the hier lowering's one cross-slice collective moves (and the byte
+    convention `refit_two_level_from_observations` expects for its
+    `dcn_observations`)."""
+    out: list[int] = []
+    for members in dcn_groups:
+        total = 0
+        for gi in members:
+            n = int(layout.group_sizes[gi])
+            padded = n + ((-n) % max(int(ici_size), 1))
+            itemsize = np.dtype(
+                comm_dtype if comm_dtype is not None else layout.dtypes[gi]
+            ).itemsize
+            total += (padded // max(int(ici_size), 1)) * int(itemsize)
+        out.append(total)
     return out
 
 

@@ -489,6 +489,22 @@ class Supervisor:
                         f"p{i}: {_json.dumps(s)}"
                         for i, s in sorted(snapshots.items())
                     )
+                    # the flight recorder's evidence (ISSUE 12): any
+                    # postmortem bundles the children wrote before the
+                    # abort are the post-mortem's starting point — name
+                    # them explicitly next to the stack-dump pointer
+                    bundles = [
+                        f"p{i}: {b.get('path')}"
+                        for i, s in sorted(snapshots.items())
+                        for b in (s.get("postmortems") or {}).get(
+                            "recent", []
+                        )
+                        if b.get("path")
+                    ]
+                    if bundles:
+                        detail += (
+                            " Postmortem bundle(s): " + "; ".join(bundles)
+                        )
                 self.log.error(
                     "watchdog abort (rc %d): a process dumped all thread "
                     "stacks before exiting%s. A wedged device grant does "

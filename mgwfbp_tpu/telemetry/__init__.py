@@ -19,6 +19,17 @@ from mgwfbp_tpu.telemetry.drift import (
     DriftDetector,
     StragglerDetector,
 )
+from mgwfbp_tpu.telemetry.health import (
+    HealthAlarm,
+    HealthConfig,
+    HealthDetector,
+)
+from mgwfbp_tpu.telemetry.recorder import (
+    FlightRecorder,
+    list_bundles,
+    read_bundle,
+    tee_observers,
+)
 from mgwfbp_tpu.telemetry.events import (
     EVENT_SCHEMA_VERSION,
     EVENT_TYPES,
@@ -56,6 +67,13 @@ __all__ = [
     "DriftConfig",
     "DriftDetector",
     "StragglerDetector",
+    "HealthAlarm",
+    "HealthConfig",
+    "HealthDetector",
+    "FlightRecorder",
+    "list_bundles",
+    "read_bundle",
+    "tee_observers",
     "ChildScrape",
     "FleetServer",
     "fleet_status",

@@ -116,6 +116,26 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # traced, `attribution` 'trace' when per-group device time attributed
     # (device_s rides along per group, layout order) or 'none'
     "profile": ("step", "steps", "attribution"),
+    # --- training-health telemetry + flight recorder (ISSUE 12) ---------
+    # one optimizer step's model-health statistics, read one step LATE
+    # off the jitted step's metrics psum (the PR-5 deque idiom — no
+    # device_get on the dispatch path). grad_norm is the global gradient
+    # L2 norm (post-reduction on the in-step lowerings; mean of the local
+    # pre-reduction norms on the sharded rs_opt_ag/rs_fwd_ag paths),
+    # update_ratio the update/param L2-norm ratio. `group_norms` rides
+    # along as the per-merge-group grad-norm list (arrival order, [] when
+    # no reducer), and `compression_error` as the per-group relative
+    # top-k compression error when a sparsifying compressor is live.
+    "health": ("step", "epoch", "loss", "grad_norm", "update_ratio"),
+    # online health-detector edge (telemetry/health.py): `kind` is
+    # 'loss_spike' | 'grad_explosion' | 'plateau' | 'compression_error';
+    # `value` the residual that crossed (or re-entered) `band`;
+    # active=True raises, False clears (two-edge Hysteresis — no flap)
+    "health_alarm": ("kind", "step", "value", "band", "active"),
+    # the flight recorder wrote one postmortem bundle (telemetry/
+    # recorder.py): `trigger` names the alarm event that tripped it,
+    # `step` the trigger's step, `path` the bundle directory
+    "postmortem": ("trigger", "step", "path"),
 }
 
 _JSON_SCALARS = (str, int, float, bool, type(None))

@@ -246,9 +246,22 @@ METRICS: tuple[tuple[str, str, str], ...] = (
      "latest straggler probe: slowest minus fastest process window "
      "step seconds"),
     ("mgwfbp_active_alarms", "gauge",
-     "currently-active drift/straggler alarms"),
+     "currently-active drift/straggler/health alarms"),
     ("mgwfbp_profile_windows_total", "counter",
      "on-demand /profile trace windows completed"),
+    # training-health telemetry + flight recorder (ISSUE 12)
+    ("mgwfbp_health_loss", "gauge",
+     "latest step loss from the in-jit health statistics"),
+    ("mgwfbp_health_grad_norm", "gauge",
+     "latest global gradient L2 norm (health statistics)"),
+    ("mgwfbp_health_update_ratio", "gauge",
+     "latest update/param L2-norm ratio (health statistics)"),
+    ("mgwfbp_health_compression_error", "gauge",
+     "latest worst per-group relative top-k compression error"),
+    ("mgwfbp_health_alarms_total", "counter",
+     "training-health alarms raised (telemetry.health)"),
+    ("mgwfbp_postmortems_total", "counter",
+     "flight-recorder postmortem bundles written"),
     # fleet fan-in synthesis (rendered only by telemetry/fleet.py's
     # /fleet/metrics, never by a per-process endpoint — registered here
     # so the fleet exposition flows through the same single registry)
@@ -275,6 +288,7 @@ EVENT_COUNTERS: dict[str, str] = {
     "preempt": "mgwfbp_preempts_total",
     "resume": "mgwfbp_resumes_total",
     "profile": "mgwfbp_profile_windows_total",
+    "postmortem": "mgwfbp_postmortems_total",
 }
 
 

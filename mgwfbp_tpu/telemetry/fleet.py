@@ -184,6 +184,28 @@ def active_alarms(children: list[ChildScrape]) -> list[dict]:
     )
 
 
+def fleet_postmortems(children: list[ChildScrape]) -> list[dict]:
+    """One row per reachable child that has written flight-recorder
+    postmortem bundles (telemetry/recorder.py): bundle count + the recent
+    manifests its /status reports — the fleet-wide postmortem index. An
+    operator chasing a group-wide anomaly reads ONE endpoint and gets
+    every process's evidence paths."""
+    rows = []
+    for c in children:
+        if not c.reachable:
+            continue
+        pm = (c.status or {}).get("postmortems") or {}
+        total = int(pm.get("total") or 0)
+        if total <= 0:
+            continue
+        rows.append({
+            "process": c.process,
+            "total": total,
+            "recent": pm.get("recent") or [],
+        })
+    return rows
+
+
 def profile_windows(children: list[ChildScrape]) -> list[dict]:
     """One row per reachable child: its /profile window state machine
     (idle/armed/running/done/failed) and, when a window completed, the
@@ -279,6 +301,7 @@ def fleet_status(
         "slowest_process": slowest,
         "active_alarms": active_alarms(children),
         "profile_windows": profile_windows(children),
+        "postmortems": fleet_postmortems(children),
     }
     if meta:
         doc.update(meta)

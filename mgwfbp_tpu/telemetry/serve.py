@@ -134,6 +134,11 @@ class MetricsAggregator:
         self._schedule: Optional[dict] = None
         self._last_drift_residual: Optional[float] = None
         self._last_straggler_excess: Optional[float] = None
+        # training-health telemetry (ISSUE 12): the latest per-step
+        # `health` record, and the flight recorder's recent bundle
+        # manifests (fed by `postmortem` events — live tee or replay)
+        self._health: Optional[dict] = None
+        self._postmortems: collections.deque = collections.deque(maxlen=20)
         # (kind, group/slow_process) -> alarm fields, kept while active
         self._active_alarms: dict = {}
         # health: None = healthy; else the reason string. Sticky once an
@@ -221,6 +226,17 @@ class MetricsAggregator:
             self._last_straggler_excess = float(
                 fields.get("excess_s", 0.0)
             )
+        elif event == "health":
+            self._health = dict(fields)
+        elif event == "health_alarm":
+            key = ("health", fields.get("kind"), fields.get("group", -1))
+            if fields.get("active"):
+                self._counts["mgwfbp_health_alarms_total"] += 1
+                self._active_alarms[key] = dict(fields, alarm="health")
+            else:
+                self._active_alarms.pop(key, None)
+        elif event == "postmortem":
+            self._postmortems.append(dict(fields))
 
     def set_schedule(
         self, comm_op: str, num_groups: int, policy_detail: str = "",
@@ -329,6 +345,7 @@ class MetricsAggregator:
             }
             out["mgwfbp_drift_alarms_total"] = 0
             out["mgwfbp_straggler_alarms_total"] = 0
+            out["mgwfbp_health_alarms_total"] = 0
             out.update(self._counts)
             if self._step_durs:
                 out["mgwfbp_step_seconds"] = (
@@ -360,6 +377,20 @@ class MetricsAggregator:
                 out["mgwfbp_straggler_excess_seconds"] = float(
                     self._last_straggler_excess
                 )
+            if self._health is not None:
+                for key, name in (
+                    ("loss", "mgwfbp_health_loss"),
+                    ("grad_norm", "mgwfbp_health_grad_norm"),
+                    ("update_ratio", "mgwfbp_health_update_ratio"),
+                ):
+                    v = self._health.get(key)
+                    if v is not None:
+                        out[name] = float(v)
+                comp = self._health.get("compression_error") or []
+                if comp:
+                    out["mgwfbp_health_compression_error"] = max(
+                        float(e) for e in comp
+                    )
             out["mgwfbp_active_alarms"] = len(self._active_alarms)
             return out
 
@@ -402,11 +433,33 @@ class MetricsAggregator:
                 "straggler_alarms": int(
                     self._counts.get("mgwfbp_straggler_alarms_total", 0)
                 ),
+                "health_alarms": int(
+                    self._counts.get("mgwfbp_health_alarms_total", 0)
+                ),
+                "health": (
+                    dict(self._health) if self._health is not None else None
+                ),
+                "postmortems": self._postmortems_locked(),
                 "active_alarms": [
                     dict(a) for a in self._active_alarms.values()
                 ],
                 "profile": self._profile_status_locked(),
             }
+
+    def _postmortems_locked(self) -> dict:
+        return {
+            "total": int(
+                self._counts.get("mgwfbp_postmortems_total", 0)
+            ),
+            "recent": [dict(b) for b in self._postmortems],
+        }
+
+    def postmortems(self) -> dict:
+        """The /postmortems JSON document: bundle count + the recent
+        manifests fed by `postmortem` events (the flight recorder's tee —
+        live runs and replayed streams list identically)."""
+        with self._lock:
+            return self._postmortems_locked()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -439,13 +492,23 @@ class _Handler(BaseHTTPRequestHandler):
                 code, doc = 200, agg.profile_status()
             body = (json.dumps(doc, indent=1) + "\n").encode()
             ctype = "application/json"
+        elif path == "/postmortems":
+            # the flight recorder's bundle index (telemetry/recorder.py):
+            # count + recent manifests, live — fed by `postmortem` events
+            # through the same validated-stream tee as everything else
+            body = (
+                json.dumps(agg.postmortems(), indent=1) + "\n"
+            ).encode()
+            ctype = "application/json"
+            code = 200
         elif path in ("/status", "/"):
             body = (json.dumps(agg.status(), indent=1) + "\n").encode()
             ctype = "application/json"
             code = 200
         else:
             body = (
-                b"not found: serve /metrics, /healthz, /status, /profile\n"
+                b"not found: serve /metrics, /healthz, /status, /profile, "
+                b"/postmortems\n"
             )
             ctype = "text/plain; charset=utf-8"
             code = 404
@@ -556,7 +619,8 @@ def start_metrics_server(
             log.warning("could not write metrics port file %s: %s",
                         port_file, e)
     log.info(
-        "metrics server: http://%s:%d (/metrics /healthz /status /profile)",
+        "metrics server: http://%s:%d "
+        "(/metrics /healthz /status /profile /postmortems)",
         server.host, server.port,
     )
     return server

@@ -107,6 +107,109 @@ def _nonfinite_count(tree: Any) -> jax.Array:
     return sum(counts).astype(jnp.float32)
 
 
+# the trainer recognizes (and strips) health statistics in the step's
+# metrics dict by this prefix — keys below it never reach the log line or
+# the scalar writer; they drain one step late through the health deque
+HEALTH_PREFIX = "health/"
+
+
+def _leaf_sumsq(tree: Any) -> list[jax.Array]:
+    """Per-leaf float32 sum of squares (0 for non-floating leaves), tree
+    order — the shared kernel of every health norm below (each leaf is
+    squared exactly once however many group/global norms consume it)."""
+    return [
+        jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        if jnp.issubdtype(leaf.dtype, jnp.floating)
+        else jnp.zeros((), jnp.float32)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]
+
+
+def _tree_norm_sq(tree: Any) -> jax.Array:
+    sq = _leaf_sumsq(tree)
+    return sum(sq) if sq else jnp.zeros((), jnp.float32)
+
+
+def _compression_error_entries(grads: Any, reducer: Any) -> dict:
+    """Per-merge-group relative top-k compression error on the LOCAL
+    pre-reduction gradients: ``||g - decompress(compress(g))|| / ||g||``.
+    Top-k keeps entries and zeroes the rest, so the dropped energy is
+    exactly ``||g||^2 - ||topk(g)||^2`` — no scatter reconstruction
+    needed. Computed on the same packed bucket AT THE WIRE DTYPE, so the
+    scalar measures the k-set the wire actually selects (a bf16 wire
+    ties differently than f32) and the ``top_k`` is operand-identical to
+    the compressor lowering's own sort wherever the sequential token
+    chain leaves the bucket value node shared (group 0 always) — XLA
+    CSEs those. Energies accumulate in float32 either way."""
+    from mgwfbp_tpu.parallel import buckets as buckets_lib
+
+    compressor = reducer.compressor
+    layout = reducer.layout
+    comm_dtype = getattr(reducer, "comm_dtype", None)
+    leaves = jax.tree_util.tree_leaves(grads)
+    arr = [leaves[j] for j in reducer.perm]
+    out: dict = {}
+    for gi in range(layout.num_groups):
+        buf = buckets_lib.pack_group(arr, layout, gi)
+        key = f"{HEALTH_PREFIX}comp_err_g{gi:04d}"
+        if not jnp.issubdtype(buf.dtype, jnp.floating):
+            out[key] = jnp.zeros((), jnp.float32)
+            continue
+        if comm_dtype is not None and buf.dtype != comm_dtype:
+            buf = buf.astype(comm_dtype)  # the lowering's wire cast
+        n = buf.shape[0]
+        k = compressor.k_for(n)
+        if k >= n:
+            out[key] = jnp.zeros((), jnp.float32)
+            continue
+        total = jnp.sum(jnp.square(buf.astype(jnp.float32)))
+        vals = lax.top_k(jnp.abs(buf), k)[0]
+        kept = jnp.sum(jnp.square(vals.astype(jnp.float32)))
+        out[key] = jnp.sqrt(
+            jnp.maximum(total - kept, 0.0) / jnp.maximum(total, 1e-30)
+        )
+    return out
+
+
+def _health_stat_entries(
+    grads: Any, reducer: Any, old_params: Any, new_params: Any
+) -> dict:
+    """Training-health scalars for the metrics dict (ISSUE 12): the
+    global gradient L2 norm, one L2 norm per merge group (arrival order),
+    and the update/param norm ratio. Every value is a float32 scalar that
+    rides the EXISTING metrics psum — no collective and no host sync is
+    added (the zero-sync pin and jaxpr rule SCH010 both enforce this).
+
+    On the in-step lowerings `grads` is the post-reduction (replica-
+    identical) gradient, so the pmean is a no-op on these values; on the
+    sharded rs_opt_ag/rs_fwd_ag paths the reduced gradients never
+    materialize, so the norms describe the LOCAL pre-reduction gradients
+    and the psum'd value is their replica mean — a health signal with the
+    same zero/non-zero and explosion semantics, exactly like the PR-5
+    non-finite count on those paths. The update ratio is likewise
+    computed on whatever param representation the path carries (full
+    replicated params, or the 1/world cross-step shards)."""
+    out: dict = {}
+    sumsq = _leaf_sumsq(grads)
+    total = sum(sumsq) if sumsq else jnp.zeros((), jnp.float32)
+    out[f"{HEALTH_PREFIX}grad_norm"] = jnp.sqrt(total)
+    if reducer is not None:
+        arr = [sumsq[j] for j in reducer.perm]
+        for gi, members in enumerate(reducer.layout.groups):
+            gsq = sum(arr[i] for i in members)
+            out[f"{HEALTH_PREFIX}gnorm_g{gi:04d}"] = jnp.sqrt(gsq)
+    delta = jax.tree_util.tree_map(
+        lambda new, old: new.astype(jnp.float32) - old.astype(jnp.float32)
+        if jnp.issubdtype(new.dtype, jnp.floating)
+        else jnp.zeros((), jnp.float32),
+        new_params, old_params,
+    )
+    unorm = jnp.sqrt(_tree_norm_sq(delta))
+    pnorm = jnp.sqrt(_tree_norm_sq(old_params))
+    out[f"{HEALTH_PREFIX}update_ratio"] = unorm / jnp.maximum(pnorm, 1e-12)
+    return out
+
+
 def make_loss_fn(
     model: Any,
     meta: ModelMeta,
@@ -246,8 +349,18 @@ def make_train_step(
     compute_dtype: Optional[Any] = None,
     donate: bool = True,
     grad_guard: bool = True,
+    health_stats: bool = False,
 ) -> Callable:
     """Build the jitted sharded train step.
+
+    health_stats: in-jit training-health statistics (ISSUE 12): per-merge-
+    group gradient L2 norms, the global gradient norm, the update/param
+    norm ratio, and — when a sparsifying compressor is live — per-group
+    relative top-k compression errors, all packed into the EXISTING
+    metrics psum under ``health/``-prefixed keys. Zero additional
+    collectives or host callbacks (jaxpr rule SCH010 pins the footprint;
+    the trainer reads the values one step late through the PR-5 deque
+    idiom, so the zero-sync contract holds too).
 
     grad_guard: the non-finite-gradient guard (resilience layer, ISSUE 5).
     The step counts non-finite elements of the (post-allreduce) gradients
@@ -423,6 +536,10 @@ def make_train_step(
         # grad reductions live under the reducer's per-group scopes (or
         # "flat_grad_reduce"); the metrics/BN-stats pmeans are declared
         # auxiliary so the verifier can tell them from hot-path strays.
+        # The optimizer update runs BEFORE the metrics psum so the health
+        # statistics (incl. the update/param ratio off the new params)
+        # can ride that one existing collective — rule SCH010 pins that
+        # turning the stats on adds no collective to this program.
         if sharded_opt or cross_step:
             if grad_guard:
                 # reduced grads never materialize on this path; count the
@@ -444,6 +561,19 @@ def make_train_step(
                     grads, state.params, state.opt_state
                 )
         else:
+            if (
+                health_stats
+                and reducer is not None
+                and getattr(reducer, "compressor", None) is not None
+                and reducer.compressor.sparse()
+            ):
+                # compression error is measured on the LOCAL pre-reduce
+                # gradients — the values the compressor actually selects
+                # over (the reduction below rebinds `grads`)
+                with jax.named_scope("health_stats"):
+                    metrics.update(
+                        _compression_error_entries(grads, reducer)
+                    )
             if reducer is not None:
                 grads = reducer(grads)
             else:
@@ -452,6 +582,15 @@ def make_train_step(
             if grad_guard:
                 with jax.named_scope("finite_check"):
                     metrics["grads_nonfinite"] = _nonfinite_count(grads)
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+        if health_stats:
+            with jax.named_scope("health_stats"):
+                metrics.update(_health_stat_entries(
+                    grads, reducer, state.params, new_params
+                ))
         with jax.named_scope("metrics_reduce"):
             metrics = lax.pmean(metrics, red_axes)
         # BN running stats: keep replicas identical (the reference leaves
@@ -460,11 +599,6 @@ def make_train_step(
         if jax.tree_util.tree_leaves(bstats):
             with jax.named_scope("bstats_reduce"):
                 bstats = lax.pmean(bstats, red_axes)
-        if not (sharded_opt or cross_step):
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

@@ -291,6 +291,27 @@ class Trainer:
         )
         self._drift_reautotune_enabled = reautotune_enabled()
         self._drift_reautotune_pending = False
+        # training-health telemetry (ISSUE 12): the jitted step packs
+        # per-group grad norms / update ratio into its metrics psum
+        # (config.health_stats); the trainer strips them one step LATE
+        # through this deque (the PR-5 guard idiom — one stacked
+        # device->host pull per drain, zero device_get on the dispatch
+        # path), streams `health` records, and feeds the online detector
+        # (telemetry/health.py), whose alarm edges trip the flight
+        # recorder (telemetry/recorder.py, wired in _build_run_sinks).
+        from mgwfbp_tpu.telemetry.health import (
+            HealthConfig,
+            HealthDetector,
+            health_enabled,
+        )
+
+        self._health_cfg = HealthConfig.from_env()
+        self._health_detector = (
+            HealthDetector(self._health_cfg)
+            if config.telemetry and config.health_stats and health_enabled()
+            else None
+        )
+        self._pending_health: deque = deque()
         # straggler probe bookkeeping: synchronous SGD equalizes
         # END-TO-END step walls across the group (everyone waits for the
         # straggler inside the collectives — on the CPU mesh even the
@@ -496,6 +517,13 @@ class Trainer:
             axis_name=self.data_axes, seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
             grad_guard=self.config.grad_guard,
+            # the statistics exist to be STREAMED: without the telemetry
+            # stream they would be computed, popped, and discarded every
+            # step — so the stream gates them (and every non-telemetry
+            # run compiles the plain step)
+            health_stats=(
+                self.config.health_stats and self.config.telemetry
+            ),
         )
         self.eval_step = make_eval_step(
             step_model, self.meta, self.mesh, axis_name=self.data_axes,
@@ -598,8 +626,47 @@ class Trainer:
                 self._metrics_agg, config.metrics_port, jax.process_index()
             )
         agg = getattr(self, "_metrics_agg", None)
-        if agg is not None and self.telemetry is not None:
-            self.telemetry.observer = agg.observe
+        # anomaly-triggered flight recorder (ISSUE 12): a bounded event
+        # ring tee'd off the SAME validated stream the aggregator reads;
+        # any alarm (drift/straggler/health/bad_step/watchdog) dumps an
+        # atomic postmortem bundle under <tag dir>/postmortems/NNNN.
+        # Rebuilt with the writer on resize rebinds (the bundle sequence
+        # under a re-used tag continues — the recorder scans the dir).
+        self._recorder = None
+        if self.telemetry is not None and tel_dir is not None:
+            from mgwfbp_tpu.telemetry.recorder import (
+                FlightRecorder,
+                recorder_enabled,
+            )
+
+            if recorder_enabled():
+                self._recorder = FlightRecorder(
+                    tel_dir,
+                    status_provider=(
+                        agg.status if agg is not None else None
+                    ),
+                    schedule_provider=self._schedule_state_doc,
+                    profile_armer=(
+                        agg.arm_profile if agg is not None else None
+                    ),
+                    event_sink=self.telemetry.emit,
+                    # a multi-host group shares the tag dir: per-process
+                    # bundle names, no rename races on the same index
+                    suffix=(
+                        f".p{jax.process_index()}"
+                        if jax.process_count() > 1 else ""
+                    ),
+                )
+        if self.telemetry is not None and (
+            agg is not None or self._recorder is not None
+        ):
+            from mgwfbp_tpu.telemetry.recorder import tee_observers
+
+            self.telemetry.observer = tee_observers(
+                agg.observe if agg is not None else None,
+                self._recorder.observe
+                if self._recorder is not None else None,
+            )
         if agg is not None:
             # a live trainer is attached: /profile?steps=N requests now
             # have a consumer (the step loop polls for armed windows)
@@ -1042,6 +1109,170 @@ class Trainer:
                 reducer.schedule.policy_detail or self.config.policy,
                 float(reducer.schedule.predicted_nonoverlap_time),
             )
+
+    def _schedule_state_doc(self) -> dict:
+        """The committed schedule + cost-model state, JSON-able — the
+        flight recorder snapshots this into every postmortem bundle so
+        'what schedule was live when it broke' survives the run."""
+        doc: dict = {"iteration": int(self.iteration)}
+        reducer = getattr(self, "reducer", None)
+        if reducer is not None:
+            doc["schedule"] = {
+                "comm_op": str(reducer.comm_op),
+                "num_groups": int(reducer.layout.num_groups),
+                "groups": [list(g) for g in reducer.layout.groups],
+                "dcn_groups": [
+                    list(d) for d in reducer.schedule.dcn_groups
+                ],
+                "policy_detail": str(
+                    reducer.schedule.policy_detail or self.config.policy
+                ),
+                "predicted_nonoverlap_s": float(
+                    reducer.schedule.predicted_nonoverlap_time
+                ),
+            }
+        cost_model = getattr(self, "cost_model", None)
+        if cost_model is not None:
+            from mgwfbp_tpu.parallel import autotune as at
+
+            doc["cost_model"] = at.model_summary(cost_model)
+        measured = getattr(self, "_measured_group_times", None)
+        if measured is not None:
+            doc["measured_group_times"] = [float(t) for t in measured]
+        return doc
+
+    # ------------------------------------------------------------------
+    # Training-health telemetry (ISSUE 12): the jitted step's health/*
+    # metrics drain one step LATE (the PR-5 deque idiom) into `health`
+    # events + the online detector; alarm edges become `health_alarm`
+    # events, which the flight recorder tee turns into postmortem
+    # bundles. Everything below is host arithmetic over already-host
+    # data — zero device_get/block_until_ready on the dispatch path
+    # (pinned by tests/test_health.py's zero-sync guard).
+    # ------------------------------------------------------------------
+
+    def _note_health_stats(self, epoch: int, metrics) -> None:
+        """Strip this step's health/* statistics from the metrics dict
+        (they are telemetry plumbing, not log-line metrics) and queue
+        them; drain all but the newest step's values — already computed
+        by now, so the stacked pull stalls nothing."""
+        if not isinstance(metrics, dict):
+            return
+        from mgwfbp_tpu.train.step import HEALTH_PREFIX
+
+        keys = [k for k in metrics if k.startswith(HEALTH_PREFIX)]
+        if not keys:
+            return
+        vals = {k: metrics.pop(k) for k in keys}
+        if self.telemetry is None:
+            return
+        vals["loss"] = metrics.get("loss", float("nan"))
+        self._pending_health.append((self.iteration, epoch, vals))
+        if len(self._pending_health) <= self._guard_interval:
+            return
+        items = [
+            self._pending_health.popleft()
+            for _ in range(len(self._pending_health) - 1)
+        ]
+        self._drain_health_batch(items)
+
+    def _drain_health_flags(self) -> None:
+        items = list(self._pending_health)
+        self._pending_health.clear()
+        self._drain_health_batch(items)
+
+    def _drain_health_batch(self, items: list) -> None:
+        if not items:
+            return
+        # a mid-run schedule rebind (autotune commit, resize) changes the
+        # per-group key set; queued items straddling it must decode with
+        # THEIR OWN keys, not the first item's — split into contiguous
+        # same-key runs (one stacked pull each; rebinds are rare, so this
+        # is one pull per drain in steady state)
+        run: list = []
+        run_keys: Optional[frozenset] = None
+        for item in items:
+            keys = frozenset(item[2])
+            if run and keys != run_keys:
+                self._drain_health_run(run)
+                run = []
+            run.append(item)
+            run_keys = keys
+        self._drain_health_run(run)
+
+    def _drain_health_run(self, items: list) -> None:
+        if not items:
+            return
+        # ONE stacked device->host pull for the whole run (key-major
+        # stack, like the guard batch) — N steps' statistics cost one RTT
+        keys = sorted(items[0][2])
+        mat = np.asarray(jnp.stack([
+            jnp.stack([
+                jnp.asarray(d[k], jnp.float32) for k in keys
+            ])
+            for _, _, d in items
+        ]))
+        from mgwfbp_tpu.train.step import HEALTH_PREFIX
+
+        g_prefix = f"{HEALTH_PREFIX}gnorm_g"
+        c_prefix = f"{HEALTH_PREFIX}comp_err_g"
+        for (it, ep, _), row in zip(items, mat):
+            vals = dict(zip(keys, (float(v) for v in row)))
+            group_norms = [
+                vals[k] for k in keys if k.startswith(g_prefix)
+            ]
+            comp = [vals[k] for k in keys if k.startswith(c_prefix)]
+            fields = {
+                "step": int(it),
+                "epoch": int(ep),
+                "loss": vals.get("loss", float("nan")),
+                "grad_norm": vals.get(
+                    f"{HEALTH_PREFIX}grad_norm", float("nan")
+                ),
+                "update_ratio": vals.get(
+                    f"{HEALTH_PREFIX}update_ratio", float("nan")
+                ),
+            }
+            if group_norms:
+                fields["group_norms"] = group_norms
+            if comp:
+                fields["compression_error"] = comp
+            self._emit_event("health", **fields)
+            det = self._health_detector
+            if det is None:
+                continue
+            for a in det.observe(
+                loss=fields["loss"],
+                grad_norm=fields["grad_norm"],
+                compression_errors=comp or None,
+            ):
+                self.log.warning(
+                    "health %s: %s alarm (value %.3g vs band %.3g) at "
+                    "iter %d",
+                    "RAISED" if a.active else "cleared", a.kind,
+                    a.value, a.band, it,
+                )
+                self._emit_event(
+                    "health_alarm", kind=a.kind, step=int(it),
+                    value=float(a.value), band=float(a.band),
+                    active=bool(a.active), group=int(a.group),
+                )
+
+    def _reset_health_detector(self) -> None:
+        """Resolve raised health alarms and forget learned baselines —
+        called after a rollback restores an older model (the baselines
+        describe statistics the restored model does not produce)."""
+        self._pending_health.clear()
+        det = self._health_detector
+        if det is None:
+            return
+        for a in det.clear_alarms():
+            self._emit_event(
+                "health_alarm", kind=a.kind, step=int(self.iteration),
+                value=float(a.value), band=float(a.band),
+                active=False, group=int(a.group),
+            )
+        det.reset()
 
     def _observe_drift_window(self, step_s: float) -> None:
         """Feed one measured log-window step time to the drift detector
@@ -1597,7 +1828,9 @@ class Trainer:
                     dcn_groups=best.dcn_groups or None,
                 ))
             total_bytes = float(sum(s.nbytes for s in specs))
-            obs, obs_source, measured_groups = self._group_observations(
+            (
+                obs, obs_source, measured_groups, dcn_obs,
+            ) = self._group_observations(
                 batch_iter, entries, total_bytes, float(sum(tb))
             )
             # the trace timed THIS schedule; remember whose groups the
@@ -1637,8 +1870,14 @@ class Trainer:
                             obs_source == "trace"
                             and self.reducer.comm_op == "hier"
                         ):
+                            # trace-separated legs: the group scopes refit
+                            # the ICI link, and — when the dcngroup scopes
+                            # attributed too — the DCN link refits from
+                            # its OWN samples instead of inheriting a
+                            # common drift factor (hier follow-up b)
                             new_model = refit_two_level_from_observations(
                                 cost_model, [], ici_observations=obs,
+                                dcn_observations=dcn_obs,
                             )
                         else:
                             new_model = refit_two_level_from_observations(
@@ -2071,13 +2310,19 @@ class Trainer:
     def _group_observations(
         self, batch_iter, entries, total_bytes: float, tb_total: float
     ):
-        """(observations, source, measured_group_times) for the cost-model
-        refit. Primary path: a profiler trace of a couple more live steps,
-        attributing wall-clock to each `mgwfbp_groupNNNN` scope
-        (profiling.trace_group_times — real TPU traces keep the scope in op
-        metadata). Fallback: step-time deltas across the raced schedules
-        (autotune.step_delta_observations — the CPU-mesh regime, where
-        traces drop the name stack)."""
+        """(observations, source, measured_group_times, dcn_observations)
+        for the cost-model refit. Primary path: a profiler trace of a
+        couple more live steps, attributing wall-clock to each
+        `mgwfbp_groupNNNN` scope (profiling.trace_group_times — real TPU
+        traces keep the scope in op metadata); on the hier lowering the
+        SAME trace additionally attributes the `mgwfbp_dcngroupNNNN`
+        scopes, so the DCN leg's (bytes, seconds) samples come back
+        separated and a drifted DCN link refits ALONE
+        (costmodel.refit_two_level_from_observations' dcn_observations —
+        ROADMAP hier follow-up b). Fallback: step-time deltas across the
+        raced schedules (autotune.step_delta_observations — the CPU-mesh
+        regime, where traces drop the name stack; dcn_observations is
+        then None and the refit falls back to the common drift factor)."""
         from mgwfbp_tpu.parallel import autotune as at
         from mgwfbp_tpu.profiling import trace_group_times
 
@@ -2092,6 +2337,14 @@ class Trainer:
             jax.block_until_ready(self.state)
 
         measured = None
+        dcn_measured = None
+        hier = self.reducer.comm_op == "hier"
+        # one derivation for BOTH the traced DCN-group count and the byte
+        # attribution below — same singleton fallback as the hier lowering
+        dcn_part = (
+            [list(d) for d in self.reducer.schedule.dcn_groups]
+            or [[gi] for gi in range(num_groups)]
+        ) if hier else []
         if coord.process_count() > 1:
             # per-process profiler traces diverge (attribution is
             # backend/host dependent), and a divergent refit means a
@@ -2104,7 +2357,18 @@ class Trainer:
             )
         else:
             try:
-                measured = trace_group_times(run, num_groups, iters=iters)
+                if hier:
+                    from mgwfbp_tpu.profiling import (
+                        trace_two_level_group_times,
+                    )
+
+                    measured, dcn_measured = trace_two_level_group_times(
+                        run, num_groups, len(dcn_part), iters=iters,
+                    )
+                else:
+                    measured = trace_group_times(
+                        run, num_groups, iters=iters
+                    )
                 self.iteration += iters
             except Exception as e:  # noqa: BLE001 — profiling must never
                 # kill the tuning phase; the step-delta fallback applies
@@ -2112,6 +2376,19 @@ class Trainer:
                     "autotune: group trace failed (%s); using step deltas",
                     e,
                 )
+        dcn_obs = None
+        if hier and dcn_measured is not None:
+            from mgwfbp_tpu.profiling import dcn_shard_nbytes
+
+            dcn_bytes = dcn_shard_nbytes(
+                self.reducer.layout, dcn_part, self.ici_size,
+                getattr(self.reducer, "comm_dtype", None),
+            )
+            dcn_obs = list(zip(dcn_bytes, dcn_measured))
+            self.log.info(
+                "autotune: trace separated %d DCN leg time(s) — the DCN "
+                "link refits from its own observations", len(dcn_obs),
+            )
         if measured is not None and num_groups >= 2:
             layout = self.reducer.layout
             nbytes = [
@@ -2119,7 +2396,7 @@ class Trainer:
                 * np.dtype(layout.dtypes[gi]).itemsize
                 for gi in range(num_groups)
             ]
-            return list(zip(nbytes, measured)), "trace", measured
+            return list(zip(nbytes, measured)), "trace", measured, dcn_obs
         # a single-group schedule yields one trace observation — not enough
         # for a 2-parameter fit; the raced entries span several group
         # counts, so fall through to the step-delta pseudo-observations
@@ -2135,11 +2412,12 @@ class Trainer:
                 "measured backward profile (run without "
                 "--no-profile-backward)"
             )
-            return [], "step-deltas", measured
+            return [], "step-deltas", measured, dcn_obs
         return (
             at.step_delta_observations(entries, total_bytes, tb_total),
             "step-deltas",
             measured,
+            dcn_obs,
         )
 
     def _apply_lm_window(self) -> None:
@@ -2655,6 +2933,9 @@ class Trainer:
             # the dispatch pipeline never stalls); may raise
             # _RollbackRequested after bad_step_limit consecutive bad steps
             self._note_guard_flag(epoch, metrics)
+            # training-health statistics drain on the same late-deque
+            # contract (and strip their keys from the log-facing metrics)
+            self._note_health_stats(epoch, metrics)
             if (
                 cfg.ckpt_every_steps
                 and self.checkpointer is not None
@@ -2728,6 +3009,7 @@ class Trainer:
         # by epoch end (the conversion below syncs anyway); a tail of bad
         # steps can still trigger the rollback here
         self._drain_guard_flags()
+        self._drain_health_flags()
         if self.telemetry is not None and epoch_steps > 0:
             epoch_dur = time.time() - t_epoch
             self._emit_event(
@@ -2864,6 +3146,7 @@ class Trainer:
         unwind with Preempted (train_cli converts it to rc 75)."""
         name = self._preempt_signal or "SIGTERM"
         self._pending_guard.clear()  # a drain outranks bad-step policy
+        self._pending_health.clear()  # ... and health bookkeeping
         if self.checkpointer is not None:
             wd = getattr(self, "_watchdog", None)
             if wd is not None:
@@ -3030,6 +3313,9 @@ class Trainer:
         self._good_step_since_rollback = False
         self._bad_streak = 0
         self._pending_guard.clear()
+        # the restored model's statistics invalidate the health
+        # detector's learned baselines; resolve raised alarms first
+        self._reset_health_detector()
         self._warned_no_rollback = False
         self._apply_snapshot(snap, "rolled back", emit_resume=False)
         self._emit_event(
@@ -3296,6 +3582,11 @@ class Trainer:
             self.checkpointer.close()
         if self.writer is not None:
             self.writer.close()
+        recorder = getattr(self, "_recorder", None)
+        if recorder is not None:
+            # a trigger at the very end of the run deferred its
+            # postmortem record; land it before the stream closes
+            recorder.flush_events()
         if self.telemetry is not None:
             self.telemetry.close()
         server = getattr(self, "_metrics_server", None)

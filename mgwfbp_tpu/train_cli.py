@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="consecutive non-finite steps before rollback to "
                         "the last checkpoint (0 disables rollback)")
+    p.add_argument("--no-health-stats", action="store_true",
+                   help="disable the in-jit training-health statistics "
+                        "(per-group grad norms, update/param ratio riding "
+                        "the metrics psum) and with them the online health "
+                        "detector + anomaly flight recorder")
     p.add_argument("--pretrain", default=None,
                    help="checkpoint directory to initialize weights from")
     p.add_argument("--seed", type=int, default=None)
@@ -183,6 +188,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         overrides["augment"] = False
     if args.no_grad_guard:
         overrides["grad_guard"] = False
+    if args.no_health_stats:
+        overrides["health_stats"] = False
     if args.tensorboard:
         overrides["tensorboard"] = True
     if args.telemetry or args.telemetry_dir or args.metrics_port is not None:

@@ -298,49 +298,14 @@ def test_abort_bound_stall_sticks_unhealthy():
     assert not agg.health()[0]
 
 
-def test_zero_sync_guard_with_server(tmp_path, monkeypatch):
-    """The PR-4 zero-sync pin, extended: the live plane (aggregator tee +
-    HTTP server + drift detector) must add ZERO device syncs to the step
-    loop — device_get/block_until_ready counts are identical with the
-    server on and everything off."""
-    from mgwfbp_tpu.train.trainer import Trainer
-
-    monkeypatch.setenv("MGWFBP_LOG_INTERVAL", "1000")
-
-    def run(live: bool) -> int:
-        cfg = make_config(
-            "lenet", lr=0.01, max_epochs=1, num_batches_per_epoch=4,
-            batch_size=8, seed=5,
-            logdir=str(tmp_path / ("on" if live else "off")),
-            telemetry=live,
-            metrics_port=0 if live else None,
-        )
-        t = Trainer(cfg, synthetic_data=True, profile_backward=False)
-        if live:
-            assert t._metrics_server is not None
-        counts = {"n": 0}
-        real_bur = jax.block_until_ready
-        real_get = jax.device_get
-
-        def counting_bur(*a, **k):
-            counts["n"] += 1
-            return real_bur(*a, **k)
-
-        def counting_get(*a, **k):
-            counts["n"] += 1
-            return real_get(*a, **k)
-
-        with monkeypatch.context() as m:
-            m.setattr(jax, "block_until_ready", counting_bur)
-            m.setattr(jax, "device_get", counting_get)
-            t.train_epoch(0)
-        if live:
-            code, _ = _get(t._metrics_server.port, "/metrics")
-            assert code == 200
-        t.close()
-        return counts["n"]
-
-    assert run(live=True) == run(live=False)
+# The PR-4/9 zero-sync pin (server + aggregator tee + drift detector add
+# zero device syncs) now lives in tests/test_health.py::
+# test_zero_sync_guard_with_health_stats_and_recorder, whose on/off
+# comparison is a strict superset: the "on" branch runs the same live
+# plane PLUS the ISSUE-12 in-jit health statistics, their deque drain,
+# the health detector, and the flight recorder tee; the "off" branch
+# disables all of it (health_stats=False removes the stats from the
+# jitted program entirely). One two-trainer comparison pins both layers.
 
 
 # ---------------------------------------------------------------------------

@@ -4,10 +4,14 @@ mesh (tools/check.sh stage).
 Single-process (default) drives the REAL launcher twice through
 subprocesses:
 
-  1. a lenet run with ``MGWFBP_FAULT_PLAN="nan@step=2;preempt@step=4"`` —
-     must drop the NaN step (``bad_step`` event), drain the injected
-     SIGTERM gracefully (step-indexed checkpoint + ``preempt`` event) and
-     exit rc 75 (EX_TEMPFAIL, restart-friendly);
+  1. a lenet run with ``MGWFBP_FAULT_PLAN=
+     "nan@step=2;stall@secs=3,step=4;preempt@step=4"`` — must drop the
+     NaN step (``bad_step`` event), write a flight-recorder postmortem
+     bundle for it (ISSUE 12) that the live ``/postmortems`` endpoint
+     serves MID-RUN (the stall before step 4 holds the run open long
+     enough to probe), drain the injected SIGTERM gracefully
+     (step-indexed checkpoint + ``preempt`` event) and exit rc 75
+     (EX_TEMPFAIL, restart-friendly);
   2. the same command with no fault plan — must resume from the exact
      mid-epoch step (``resume`` event with mid_epoch) and finish rc 0.
 
@@ -122,6 +126,15 @@ def _run(
                     code, body = _probe(metrics_port, "/healthz")
                     assert code == 200, f"/healthz mid-run: {code} {body}"
                     probes["healthz"] = body.strip()
+            if metrics_port and "postmortems" not in probes:
+                # the injected-NaN bad step must leave a flight-recorder
+                # bundle that /postmortems lists WHILE the run is up
+                # (the stall@step=4 in the plan holds the window open)
+                code, body = _probe(metrics_port, "/postmortems")
+                if code == 200:
+                    doc = json.loads(body)
+                    if doc.get("total", 0) >= 1 and doc.get("recent"):
+                        probes["postmortems"] = doc
             time.sleep(0.1)
     with open(out_path) as f:
         tail = f.read()[-4000:]
@@ -148,11 +161,22 @@ def single_process() -> dict:
 
     with tempfile.TemporaryDirectory(prefix="mgwfbp_fault_smoke_") as d:
         port = _free_port()
-        rc, probes = _run(d, "nan@step=2;preempt@step=4", metrics_port=port)
+        rc, probes = _run(
+            d, "nan@step=2;stall@secs=3,step=4;preempt@step=4",
+            metrics_port=port,
+        )
         assert rc == PREEMPT_RC, (
             f"faulted run exited rc {rc}, want {PREEMPT_RC} (EX_TEMPFAIL)"
         )
         assert probes.get("healthz") == "ok", probes
+        # the live /postmortems probe answered mid-run, naming the bundle
+        pm_doc = probes.get("postmortems")
+        assert pm_doc is not None, (
+            "/postmortems never listed the injected-NaN bundle mid-run; "
+            f"probes: {sorted(probes)}"
+        )
+        assert pm_doc["recent"][0]["trigger"] == "bad_step", pm_doc
+        assert pm_doc["recent"][0]["step"] == 2, pm_doc
         recs = _events(d)
         bad = events_of(recs, "bad_step")
         assert bad and bad[0]["step"] == 2, f"bad_step missing/wrong: {bad}"
@@ -161,6 +185,23 @@ def single_process() -> dict:
         assert pre["signal"] == "SIGTERM" and pre["iteration"] == 4, pre
         ckpts = events_of(recs, "checkpoint")
         assert any(c.get("mid_epoch") for c in ckpts), ckpts
+        # ... and the bundle itself is on disk, atomic and complete,
+        # naming the bad step (ISSUE 12 flight recorder)
+        from mgwfbp_tpu.telemetry.recorder import list_bundles, read_bundle
+
+        (tag_dir,) = [
+            p for p in glob.glob(os.path.join(d, "*"))
+            if os.path.isdir(os.path.join(p, "postmortems"))
+        ]
+        bundles = list_bundles(tag_dir)
+        assert bundles, f"no postmortem bundle on disk under {d}"
+        bundle = read_bundle(bundles[0])
+        assert bundle["manifest"]["trigger"] == "bad_step", bundle
+        assert bundle["manifest"]["step"] == 2, bundle["manifest"]
+        assert any(
+            r.get("event") == "bad_step" for r in bundle["events"]
+        ), "ring dump lacks the triggering bad_step record"
+        assert bundle.get("schedule"), "schedule state missing from bundle"
 
         rc, _ = _run(d, "")
         assert rc == 0, f"resume run exited rc {rc}"
@@ -179,6 +220,7 @@ def single_process() -> dict:
             "resume_iteration": resumes[-1]["iteration"],
             "final_step": max(s["step"] for s in steps),
             "live_metrics_probed": sorted(probes),
+            "postmortem_bundle": bundle["manifest"]["path"],
         }
 
 

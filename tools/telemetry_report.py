@@ -157,12 +157,14 @@ def format_report(records: list[dict]) -> str:
         lines.append("overlap: no snapshot recorded (single-device run, "
                      "policy 'none', or telemetry off during fit)")
 
-    alarms = events_of(records, "drift_alarm", "straggler")
+    lines.extend(_health_section(records))
+
+    alarms = events_of(records, "drift_alarm", "straggler", "health_alarm")
     if alarms:
         lines.append("")
         lines.append("alarms:")
         lines.append(
-            f"  {'kind':>14} {'edge':>6} {'group/proc':>10} "
+            f"  {'kind':>17} {'edge':>6} {'group/proc':>10} "
             f"{'residual':>10} {'band':>8} {'step':>8}"
         )
         for r in alarms:
@@ -174,13 +176,21 @@ def format_report(records: list[dict]) -> str:
                 )
                 residual = _fmt_s(r.get("residual"))
                 band = _fmt_s(r.get("band"))
+            elif r.get("event") == "health_alarm":
+                kind = str(r.get("kind"))
+                who = (
+                    str(r.get("group"))
+                    if int(r.get("group", -1)) >= 0 else "agg"
+                )
+                residual = _fmt_s(r.get("value"))
+                band = _fmt_s(r.get("band"))
             else:
                 kind = "straggler"
                 who = f"p{r.get('slow_process')}"
                 residual = _fmt_s(r.get("excess_s"))
                 band = "-"
             lines.append(
-                f"  {kind:>14} "
+                f"  {kind:>17} "
                 f"{'RAISE' if r.get('active') else 'clear':>6} "
                 f"{who:>10} {residual:>10} {band:>8} "
                 f"{str(r.get('step', '-')):>8}"
@@ -230,6 +240,78 @@ def format_report(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def _ewma(values: list[float], alpha: float = 0.1):
+    out = None
+    for v in values:
+        if v != v:  # NaN — a bad step's loss; skip, don't poison
+            continue
+        out = v if out is None else alpha * v + (1.0 - alpha) * out
+    return out
+
+
+def _health_section(records: list[dict]) -> list[str]:
+    """Training-health section (ISSUE 12): loss trend/EWMA, grad-norm
+    trend, update ratio, the per-merge-group grad-norm trend, and the
+    postmortem bundle index."""
+    from mgwfbp_tpu.telemetry import events_of
+
+    lines: list[str] = []
+    health = events_of(records, "health")
+    if health:
+        losses = [float(h.get("loss", float("nan"))) for h in health]
+        norms = [float(h.get("grad_norm", float("nan"))) for h in health]
+        ratios = [
+            float(h.get("update_ratio", float("nan"))) for h in health
+        ]
+        finite_n = [v for v in norms if v == v]
+        lines.append("")
+        lines.append(f"training health ({len(health)} records):")
+        lines.append(
+            f"  loss: first {_fmt_s(losses[0])} -> last "
+            f"{_fmt_s(losses[-1])} (ewma {_fmt_s(_ewma(losses))}); "
+            f"update/param ratio last {_fmt_s(ratios[-1])}"
+        )
+        if finite_n:
+            lines.append(
+                f"  grad norm: first {_fmt_s(norms[0])} -> last "
+                f"{_fmt_s(norms[-1])} (max {_fmt_s(max(finite_n))})"
+            )
+        bad = sum(1 for v in losses if v != v)
+        if bad:
+            lines.append(
+                f"  non-finite loss records: {bad} (see bad_step rows)"
+            )
+        per_group = [h.get("group_norms") for h in health]
+        per_group = [g for g in per_group if g]
+        if per_group and len(per_group[-1]) == len(per_group[0]):
+            lines.append(
+                f"  {'group':>5} {'gnorm_first':>12} {'gnorm_last':>12}"
+            )
+            for gi in range(len(per_group[0])):
+                lines.append(
+                    f"  {gi:>5} {_fmt_s(per_group[0][gi]):>12} "
+                    f"{_fmt_s(per_group[-1][gi]):>12}"
+                )
+        comp = [h.get("compression_error") for h in health]
+        comp = [c for c in comp if c]
+        if comp:
+            lines.append(
+                f"  compression error (worst group): first "
+                f"{_fmt_s(max(comp[0]))} -> last {_fmt_s(max(comp[-1]))}"
+            )
+    pms = events_of(records, "postmortem")
+    if pms:
+        lines.append("")
+        lines.append(f"postmortem bundles ({len(pms)}):")
+        lines.append(f"  {'trigger':>15} {'step':>8}  path")
+        for r in pms:
+            lines.append(
+                f"  {str(r.get('trigger')):>15} "
+                f"{str(r.get('step', '-')):>8}  {r.get('path')}"
+            )
+    return lines
+
+
 def _alarm_lines(alarms: list[dict]) -> list[str]:
     """Active-alarm table rows (live /status and /fleet/status share the
     same alarm dicts the aggregator keeps)."""
@@ -243,12 +325,13 @@ def _alarm_lines(alarms: list[dict]) -> list[str]:
             residual = _fmt_s(a.get("excess_s"))
             band = "-"
         else:
+            # drift alarms report `residual`, health alarms `value`
             kind = str(a.get("kind"))
             who = (
                 str(a.get("group"))
                 if int(a.get("group", -1)) >= 0 else "agg"
             )
-            residual = _fmt_s(a.get("residual"))
+            residual = _fmt_s(a.get("residual", a.get("value")))
             band = _fmt_s(a.get("band"))
         procs = a.get("processes")
         lines.append(
@@ -296,6 +379,37 @@ def format_live_report(status: dict, values: dict) -> str:
             f"exposed {_fmt_s(values.get('mgwfbp_comm_exposed_seconds'))}"
             " s per step)"
         )
+    health = status.get("health")
+    if health:
+        lines.append(
+            f"training health (step {health.get('step')}): loss "
+            f"{_fmt_s(health.get('loss'))}, grad norm "
+            f"{_fmt_s(health.get('grad_norm'))}, update/param ratio "
+            f"{_fmt_s(health.get('update_ratio'))}"
+        )
+        gn = health.get("group_norms") or []
+        if gn:
+            lines.append(
+                "  per-group grad norms: "
+                + ", ".join(
+                    f"g{gi}={_fmt_s(v)}" for gi, v in enumerate(gn)
+                )
+            )
+        comp = health.get("compression_error") or []
+        if comp:
+            lines.append(
+                f"  compression error (worst group): {_fmt_s(max(comp))}"
+            )
+    pm = status.get("postmortems") or {}
+    if pm.get("total"):
+        lines.append(
+            f"postmortem bundles: {pm['total']} written"
+        )
+        for b in pm.get("recent", []):
+            lines.append(
+                f"  {b.get('trigger')} @ step {b.get('step')}: "
+                f"{b.get('path')}"
+            )
     alarms = status.get("active_alarms") or []
     lines.append("")
     if alarms:
@@ -316,6 +430,8 @@ def format_live_report(status: dict, values: dict) -> str:
         ("mgwfbp_autotune_commits_total", "autotune commits"),
         ("mgwfbp_drift_alarms_total", "drift alarms"),
         ("mgwfbp_straggler_alarms_total", "straggler alarms"),
+        ("mgwfbp_health_alarms_total", "health alarms"),
+        ("mgwfbp_postmortems_total", "postmortem bundles"),
         ("mgwfbp_profile_windows_total", "profile windows"),
     ):
         v = values.get(key, 0)
@@ -377,6 +493,19 @@ def format_fleet_report(doc: dict) -> str:
         lines.extend(_alarm_lines(alarms))
     else:
         lines.append("fleet active alarms: none")
+    pms = doc.get("postmortems") or []
+    if pms:
+        lines.append("")
+        lines.append("fleet postmortem bundles:")
+        for row in pms:
+            lines.append(
+                f"  p{row.get('process')}: {row.get('total')} bundle(s)"
+            )
+            for b in row.get("recent", []):
+                lines.append(
+                    f"    {b.get('trigger')} @ step {b.get('step')}: "
+                    f"{b.get('path')}"
+                )
     for u in doc.get("unreachable") or []:
         lines.append(
             f"UNREACHABLE: p{u.get('process')} at {u.get('target')} "
@@ -461,6 +590,22 @@ def _synthetic_stream(path: str) -> None:
            band=3.0, active=False, group=1)
     w.emit("straggler", step=22, slow_process=1, excess_s=0.013,
            step_s_max=0.058, step_s_min=0.045, active=True)
+    # training-health stream + alarm + postmortem (ISSUE 12)
+    for i in range(24):
+        w.emit(
+            "health", step=i, epoch=0,
+            loss=2.0 - 0.05 * i if i != 20 else 9.0,
+            grad_norm=1.0 + (8.0 if i == 20 else 0.0),
+            update_ratio=1e-3,
+            group_norms=[0.8, 0.6],
+            compression_error=[0.02, 0.03],
+        )
+    w.emit("health_alarm", kind="loss_spike", step=20, value=5.2,
+           band=2.0, active=True, group=-1)
+    w.emit("health_alarm", kind="loss_spike", step=22, value=1.1,
+           band=2.0, active=False, group=-1)
+    w.emit("postmortem", trigger="health_alarm", step=20,
+           path="/tmp/run/postmortems/0000")
     w.close()
 
 
@@ -478,6 +623,13 @@ def selftest() -> int:
         report = format_report(records)
         assert "overlap efficiency" in report, report
         assert "alarms:" in report and "straggler" in report, report
+        # ISSUE 12: training-health section, health alarm row, and the
+        # postmortem index table all render from the same stream
+        assert "training health (24 records)" in report, report
+        assert "loss_spike" in report, report
+        assert "postmortem bundles (1):" in report, report
+        assert "/tmp/run/postmortems/0000" in report, report
+        assert "gnorm_first" in report, report
         trace_path = os.path.join(d, "trace.json")
         doc = write_chrome_trace(trace_path, records)
         with open(trace_path) as f:
@@ -496,6 +648,9 @@ def selftest() -> int:
         agg.replay(records)
         assert render_metrics(agg.values()) == prom
         assert "mgwfbp_drift_alarms_total 1" in prom, prom
+        assert "mgwfbp_health_alarms_total 1" in prom, prom
+        assert "mgwfbp_postmortems_total 1" in prom, prom
+        assert "mgwfbp_health_grad_norm" in prom, prom
         # --live round trip: serve the replayed aggregator over HTTP and
         # render the live report from /status + /metrics; then fan two
         # such children into a fleet view (ISSUE 10) and render that
