@@ -67,8 +67,8 @@ class TrainConfig:
     # compressor) | rs_fwd_ag (cross-step pipelining: rs_opt_ag whose
     # per-group all-gather is DEFERRED into the NEXT step's forward, so
     # comm hides behind forward compute too; params carried as 1/world
-    # shards between steps — same constraints as rs_opt_ag, single-process
-    # only for now)
+    # shards between steps — same constraints as rs_opt_ag; multi-host
+    # capable since the shard-native checkpoint/interchange seam)
 
     # numerics
     dtype: str = "float32"  # param/compute dtype
@@ -100,6 +100,15 @@ class TrainConfig:
     # MGWFBP_METRICS_PORT (the generic MGWFBP_<field> override)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 1
+    ckpt_format: str = "sharded"  # sharded | replicated (ISSUE 13):
+    # 'sharded' writes the shard-native format — each process saves only
+    # its own shard rows plus a manifest, so sharded comm paths
+    # (rs_opt_ag / rs_fwd_ag) never gather world-sized state to save,
+    # and a restore re-shards onto any world size / merge schedule.
+    # 'replicated' is the escape hatch: the legacy orbax payload in the
+    # gathered interchange form, for interchange with pre-ISSUE-13
+    # consumers. Both formats RESTORE transparently regardless of this
+    # setting (it selects the save side only).
     # resilience layer (ISSUE 5)
     ckpt_every_steps: int = 0  # mid-epoch step-indexed checkpoints every N
     # optimizer steps (0 = epoch boundaries only); a SIGTERM/SIGINT drain

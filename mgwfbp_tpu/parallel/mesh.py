@@ -186,6 +186,27 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def gather_replicated(tree, mesh: Mesh, cache: dict):
+    """Sharded pytree -> replicated (hence fully-addressable) global
+    arrays via ONE cached jitted identity with replicated out_shardings —
+    the collective twin of np.asarray, shared by every consumer that
+    needs a replicated view of device-sharded state (the
+    ShardedOptimStep interchange seam, the trainer's carry snapshot).
+    `cache` is caller-owned (keyed by tree structure) so each consumer's
+    programs survive across calls without retracing."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return tree
+    key = jax.tree_util.tree_structure(tree)
+    prog = cache.get(key)
+    if prog is None:
+        prog = jax.jit(
+            lambda t: t, out_shardings=NamedSharding(mesh, P())
+        )
+        cache[key] = prog
+    return prog(tree)
+
+
 def process_count() -> int:
     return jax.process_count()
 

@@ -36,12 +36,16 @@ class ResizeUnsupported(RuntimeError):
     def __init__(self, reason: str, nworkers: int):
         super().__init__(
             f"{reason}. Elastic resize on this configuration is "
-            "resize-by-relaunch: stop the group (SIGTERM drains to a "
-            "step-indexed checkpoint, rc 75), then relaunch at the new "
-            "size under the supervisor —\n"
-            "  python -m mgwfbp_tpu.runtime.supervise --processes <N> -- "
-            "<same train args>\n"
-            "The resumed run restores bitwise from the drained checkpoint "
+            "resize-by-relaunch, and the supervisor automates it "
+            "(ISSUE 13): launch with\n"
+            "  python -m mgwfbp_tpu.runtime.supervise --processes <N> "
+            "--resize-to <M> -- <same train args>\n"
+            "— the group drains via the agreed-preempt path (rc 75), "
+            "relaunches at <M> processes with MGWFBP_ELASTIC_RESUME=1, "
+            "and the job continues from the exact step (shard-native "
+            "checkpoints re-shard per leaf onto the new world; no "
+            "world-sized buffer is ever materialized). Manual recipe: "
+            "SIGTERM the group, then relaunch at the new size "
             f"(requested worker count: {nworkers})."
         )
         self.nworkers = nworkers

@@ -190,6 +190,21 @@ def gather_vectors(values: Sequence[float]) -> list[list[float]]:
     ]
 
 
+def agree_uniform(value: float) -> bool:
+    """True iff every process passed the SAME scalar (max == min across
+    the group). The cheap divergence guard for values that MUST be
+    group-uniform before a collective side effect — e.g. the step key a
+    shard-native checkpoint commit is about to write: processes saving
+    different steps means the lockstep invariant already broke, and
+    writing a torn manifest would bake the divergence into disk."""
+    if process_count() == 1:
+        return True
+    v = float(value)
+    mx = float(_device_reduce([v], "max")[0])
+    mn = -float(_device_reduce([-v], "max")[0])
+    return mx == mn
+
+
 def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
     """Agreed argmin over per-candidate timings.
 

@@ -65,6 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist the children's ACTUAL metrics endpoints "
                         "here in Prometheus http_sd format (default: "
                         "<log-dir>/fleet.json when --log-dir is set)")
+    p.add_argument("--resize-to", dest="resize_to", type=int, default=None,
+                   help="elastic resize: relaunch the group at this many "
+                        "processes at the next drain. With "
+                        "MGWFBP_METRICS_PORT set the supervisor initiates "
+                        "the drain itself (SIGTERM once a child reports a "
+                        "completed step); the relaunched incarnation "
+                        "resumes from the exact step — shard-native "
+                        "checkpoints re-shard onto the new world size")
     p.add_argument("train_args", nargs=argparse.REMAINDER,
                    help="arguments for mgwfbp_tpu.train_cli (prefix "
                         "with --)")
@@ -88,6 +96,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         port=args.port,
         fleet_port=args.fleet_port,
         fleet_file=args.fleet_file,
+        resize_to=args.resize_to,
     )
     return sup.run()
 

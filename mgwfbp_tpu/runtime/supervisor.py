@@ -45,6 +45,17 @@ metrics under a ``process`` label, /fleet/status synthesizes the live
 straggler table, slowest-process attribution, and the group's active
 alarms (telemetry/fleet.py).
 
+Elastic resize (ISSUE 13): ``--resize-to M`` relaunches the NEXT
+incarnation at M processes instead of N. With the live plane configured
+the supervisor initiates the drain itself — SIGTERM to the whole group
+once a child reports a completed step over /status (the agreed-preempt
+path checkpoints shard-native and exits rc 75); without it the resize
+applies at the next natural preemption. Children get
+MGWFBP_ELASTIC_RESUME=1 so a relaunch at a new size finds the old
+world's checkpoints under their sibling tag and re-shards
+(train.trainer._resume_cross_world); /fleet/status carries the
+transition as a ``resize`` view while it happens.
+
 `python -m mgwfbp_tpu.runtime.supervise --processes 2 -- <train args>`
 is the CLI (see runtime/supervise.py).
 """
@@ -124,10 +135,13 @@ class Supervisor:
         port: Optional[int] = None,
         fleet_port: Optional[int] = None,
         fleet_file: Optional[str] = None,
+        resize_to: Optional[int] = None,
         sleep: Callable[[float], None] = time.sleep,
     ):
         if processes < 1:
             raise ValueError(f"processes must be >= 1, got {processes}")
+        if resize_to is not None and resize_to < 1:
+            raise ValueError(f"resize_to must be >= 1, got {resize_to}")
         self.base_cmd = list(base_cmd)
         self.processes = int(processes)
         self.max_restarts = int(max_restarts)
@@ -148,12 +162,25 @@ class Supervisor:
         # fleet console (ISSUE 10): fan-in server port (None = off,
         # 0 = ephemeral), http_sd sidecar path, port-file directory
         self.fleet_port = fleet_port
+        self._fleet_file_explicit = fleet_file is not None
         self.fleet_file = fleet_file or (
             os.path.join(log_dir, "fleet.json") if log_dir else None
         )
         self.fleet_server = None
         self._ports_dir: Optional[str] = None
         self._last_fleet_targets: Optional[dict] = None
+        # supervisor-driven elastic resize (ISSUE 13): relaunch the next
+        # incarnation at `resize_to` processes once the current one
+        # drains. With the live plane configured the supervisor TRIGGERS
+        # the drain itself (SIGTERM to the whole group as soon as a child
+        # reports a completed step — the agreed-preempt path takes it
+        # from there); otherwise the resize applies at the next natural
+        # preemption.
+        self.resize_to = resize_to
+        self._initial_processes = int(processes)
+        self._resize_signaled = False
+        self._resize_poll_t = 0.0
+        self._resize_no_metrics_warned = False
 
     # -- launch ------------------------------------------------------------
     def _metrics_base_port(self) -> Optional[int]:
@@ -258,10 +285,71 @@ class Supervisor:
 
     def _fleet_meta(self) -> dict:
         """Supervisor-level fields for /fleet/status."""
-        return {
+        meta = {
             "incarnation": len(self.results),
             "processes_configured": self.processes,
         }
+        if self.resize_to is not None:
+            # the transition is fleet-visible: pending while the group
+            # still runs at the old size, done once an incarnation
+            # launched at the target
+            meta["resize"] = {
+                "from": self._initial_processes,
+                "to": self.resize_to,
+                "state": (
+                    "done"
+                    if self.processes == self.resize_to
+                    else "pending"
+                ),
+                "triggered": bool(self._resize_signaled),
+            }
+        return meta
+
+    def _resize_pending(self) -> bool:
+        return (
+            self.resize_to is not None
+            and self.resize_to != self.processes
+        )
+
+    def _maybe_trigger_resize(self, procs) -> None:
+        """--resize-to with a healthy group: initiate the drain ourselves
+        — SIGTERM the whole group once any child reports a COMPLETED step
+        over /status (signal handlers are armed by then; an earlier
+        signal would kill a child mid-bootstrap instead of draining it).
+        Needs the live plane; without it the resize waits for the next
+        natural preemption."""
+        if not self._resize_pending() or self._resize_signaled:
+            return
+        if not self._metrics_enabled():
+            if not self._resize_no_metrics_warned:
+                self._resize_no_metrics_warned = True
+                self.log.warning(
+                    "--resize-to %d: MGWFBP_METRICS_PORT is not set, so "
+                    "the supervisor cannot see training progress to time "
+                    "the drain; the resize will apply at the next "
+                    "preemption (rc 75) instead", self.resize_to,
+                )
+            return
+        now = time.monotonic()
+        if now - self._resize_poll_t < 0.5:  # throttle the /status polls
+            return
+        self._resize_poll_t = now
+        for i in range(self.processes):
+            st = self._child_status(i)
+            if st and int(st.get("step") or 0) >= 1:
+                self.log.warning(
+                    "resize %d -> %d: draining the group (SIGTERM; the "
+                    "agreed-preempt path checkpoints and exits rc 75)",
+                    self.processes, self.resize_to,
+                )
+                self._resize_signaled = True
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+                return
 
     def _start_fleet_server(self) -> None:
         """One fan-in server for the supervisor's lifetime (targets
@@ -307,11 +395,27 @@ class Supervisor:
         env["MGWFBP_COORDINATOR"] = f"127.0.0.1:{port}"
         env["MGWFBP_NUM_PROCESSES"] = str(self.processes)
         env["MGWFBP_PROCESS_ID"] = str(idx)
+        # supervised groups may resume across world-size changes: a
+        # relaunch at a new --processes finds the old world's checkpoints
+        # under their sibling tag and re-shards (trainer
+        # _resume_cross_world). Explicit operator values win.
+        env.setdefault("MGWFBP_ELASTIC_RESUME", "1")
         if self._metrics_enabled():
             # the child persists its ACTUAL bound metrics port here
             # (telemetry/serve.write_port_file) — the fleet fan-in and
             # fleet.json read real ports, never the base+index guess
             env["MGWFBP_METRICS_PORT_FILE"] = self._port_file(idx)
+            if self.fleet_port is not None or self._fleet_file_explicit:
+                # cross-host seam: with the fleet plane armed (a fan-in
+                # server or a fleet.json sidecar for an external
+                # Prometheus) the children default to a ROUTABLE bind so
+                # off-host consumers can reach them, and the port file
+                # advertises the resolved routable address. Scoped to
+                # the armed-fleet case deliberately: the endpoints are
+                # unauthenticated, so a plain supervised run keeps the
+                # loopback default (and explicit operator values always
+                # win).
+                env.setdefault("MGWFBP_METRICS_HOST", "0.0.0.0")
         return env
 
     def _spawn(self, idx: int, incarnation: int, port: int):
@@ -389,6 +493,8 @@ class Supervisor:
             # keep the fleet.json sidecar current (no-op when the live
             # plane is off or nothing changed)
             self._refresh_fleet()
+            # --resize-to: drain a healthy group once it is stepping
+            self._maybe_trigger_resize(procs)
             pending = [p for p in procs if p.poll() is None]
             if not pending:
                 return [int(p.returncode) for p in procs]
@@ -528,15 +634,31 @@ class Supervisor:
                 if pos:
                     return pos[0]
                 return 128 + abs(bad[0]) if bad else 1
-            if restarts >= self.max_restarts:
-                self.log.error(
-                    "preempted again but the restart budget (%d) is "
-                    "spent; progress is checkpointed — resubmit manually "
-                    "or raise --max-restarts", self.max_restarts,
+            resize_relaunch = self._resize_pending()
+            if resize_relaunch:
+                # realizing --resize-to is not failure recovery: the
+                # relaunch at the new size neither consumes the restart
+                # budget nor gets blocked by an already-spent one (the
+                # supervisor may itself have SIGTERMed a healthy group to
+                # drain it — refusing to relaunch would strand the job)
+                self.log.warning(
+                    "elastic resize: relaunching the group at %d "
+                    "process(es) (was %d); the job continues from the "
+                    "drained step", self.resize_to, self.processes,
                 )
-                return PREEMPT_RC
-            restarts += 1
-            delay = self.backoff_s(restarts)
+                self.processes = int(self.resize_to)
+                delay = self.backoff_base_s
+            else:
+                if restarts >= self.max_restarts:
+                    self.log.error(
+                        "preempted again but the restart budget (%d) is "
+                        "spent; progress is checkpointed — resubmit "
+                        "manually or raise --max-restarts",
+                        self.max_restarts,
+                    )
+                    return PREEMPT_RC
+                restarts += 1
+                delay = self.backoff_s(restarts)
             self.log.warning(
                 "group preempted (rc %d): resubmitting in %.1fs "
                 "(restart %d/%d) — resumed run restores from the drained "

@@ -74,10 +74,15 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
                       "measured_step_s"),
     "autotune_commit": ("winner", "comm_op", "num_groups", "source"),
     # elastic resize seam; schedule_source records which path won the
-    # post-resize schedule ("schedule-cache" vs "solver")
+    # post-resize schedule ("schedule-cache" vs "solver" for an in-place
+    # update_nworker, "relaunch-reshard" when a supervisor-driven
+    # relaunch re-sharded a sibling world's shard-native checkpoint)
     "resize": ("old_world", "new_world", "schedule_source", "num_groups"),
     # a written snapshot; mid_epoch=True rows (the --ckpt-every-steps /
-    # preemption-drain path) additionally carry epoch_step
+    # preemption-drain path) additionally carry epoch_step. Rows also
+    # carry the save cost — duration_s + bytes (this process's payload)
+    # + format ("sharded" | "replicated") — so the report tool and
+    # flight recorder surface checkpoint-cost regressions
     "checkpoint": ("epoch", "iteration", "mid_epoch"),
     # watchdog stall/abort (also CRITICAL-logged; this makes it greppable
     # from the same file as the step records)

@@ -77,6 +77,44 @@ PROFILE_MAX_STEPS = 50
 _STEP_WINDOW = 20
 
 
+def routable_host() -> str:
+    """This machine's best routable address, for ADVERTISING a wildcard
+    bind (0.0.0.0) to off-host scrapers: the fleet fan-in and an external
+    Prometheus reading fleet.json need an address a peer host can dial,
+    and the wildcard is not one. Resolution: the kernel's outbound-route
+    pick (a UDP connect sends nothing), then the hostname's address, then
+    loopback — each step degrades, never raises."""
+    import socket as _socket
+
+    try:
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 9))
+            host = s.getsockname()[0]
+            if host and not host.startswith("0."):
+                return host
+        finally:
+            s.close()
+    except OSError:
+        pass
+    try:
+        host = _socket.gethostbyname(_socket.gethostname())
+        if host:
+            return host
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+def advertised_host(bound_host: str) -> str:
+    """The address peers should DIAL for a server bound at `bound_host`:
+    wildcard binds advertise the routable address, concrete binds
+    advertise themselves."""
+    if bound_host in ("", "0.0.0.0", "::"):
+        return routable_host()
+    return bound_host
+
+
 def resolve_metrics_port(
     base_port: Optional[int], process_index: int = 0
 ) -> Optional[int]:
@@ -577,7 +615,10 @@ def write_port_file(
     simply wrong when the base is 0 (per-process ephemeral ports)."""
     doc = {
         "process": int(process_index),
-        "host": server.host,
+        # a 0.0.0.0 bind advertises the ROUTABLE address (cross-host
+        # seam): fleet.json targets must be dialable from other hosts
+        "host": advertised_host(server.host),
+        "bound_host": server.host,
         "port": int(server.port),
         "pid": os.getpid(),
     }

@@ -63,6 +63,17 @@ def derive_agree_interval(step_s: float, grace_s: float = 30.0) -> int:
     return int(min(max(grace_s * 0.5 / step_s, 1.0), 1000.0))
 
 
+def _elastic_resume_enabled() -> bool:
+    """True when a relaunch may resume from a SIBLING tag directory
+    written at a different world size (re-sharding the state onto the
+    new layout). The supervisor exports MGWFBP_ELASTIC_RESUME=1 for the
+    groups it launches — a resize-by-relaunch must find the old world's
+    checkpoints; standalone runs keep the exact-tag-only behavior unless
+    the operator opts in."""
+    raw = (os.environ.get("MGWFBP_ELASTIC_RESUME") or "").strip().lower()
+    return raw in ("1", "true", "yes")
+
+
 class _RollbackRequested(Exception):
     """Internal: K consecutive non-finite steps — unwind train_epoch so
     _fit_epochs can restore the last checkpoint and continue from there."""
@@ -445,6 +456,62 @@ class Trainer:
                 params=self.reducer.optim.scatter_params(state.params)
             )
         return state
+
+    # -- multi-host-capable interchange (ISSUE 13) ----------------------
+    # `_to/_from_checkpoint_state` pack and unpack on the HOST, which
+    # needs every buffer locally addressable — single-process only. These
+    # twins route through the collective seam (`ShardedOptimStep.
+    # replicate` all-gathers the shards into replicated global arrays;
+    # `scatter_*_onto` re-shards host buffers as global arrays) so the
+    # replicated interchange form exists wherever it is GENUINELY needed
+    # (eval, autotune hot-swaps, the --ckpt-format replicated escape
+    # hatch) at pod scale too. Checkpoints themselves no longer pass
+    # through here — the shard-native format saves/restores per-process
+    # shards directly.
+
+    def _to_interchange_state(self, state):
+        if not (self._sharded_opt or self._cross_step):
+            return state
+        if jax.process_count() == 1:
+            return self._to_checkpoint_state(state)
+        optim = self.reducer.optim
+        if self._cross_step:
+            state = state.replace(
+                params=optim.gather_params(
+                    optim.replicate(state.params), self._params_template
+                )
+            )
+        return state.replace(
+            opt_state=optim.gather(
+                optim.replicate(state.opt_state), self.tx, state.params
+            )
+        )
+
+    def _from_interchange_state(self, state):
+        if not (self._sharded_opt or self._cross_step):
+            return state
+        if jax.process_count() == 1:
+            return self._from_checkpoint_state(state)
+        optim = self.reducer.optim
+        state = state.replace(
+            opt_state=optim.scatter_onto(
+                state.opt_state, state.params, self.mesh
+            )
+        )
+        if self._cross_step:
+            state = state.replace(
+                params=optim.scatter_params_onto(state.params, self.mesh)
+            )
+        return state
+
+    def _gathered_params(self, shards):
+        """Canonical replicated params from the cross-step carry — the
+        collective route on a multi-host mesh, the host unpack otherwise
+        (bitwise identical either way)."""
+        optim = self.reducer.optim
+        if jax.process_count() > 1:
+            shards = optim.replicate(shards)
+        return optim.gather_params(shards, self._params_template)
 
     # ------------------------------------------------------------------
     def _build_loaders(self):
@@ -2113,12 +2180,12 @@ class Trainer:
         re-scattered under its layout before the error propagates — a
         half-installed swap would corrupt every later gather."""
         old = self.reducer
-        self.state = self._to_checkpoint_state(self.state)
+        self.state = self._to_interchange_state(self.state)
         self._measured_group_times = None  # traced under the old schedule
         self.reducer = reducer
         scattered = False
         try:
-            self.state = self._from_checkpoint_state(self.state)
+            self.state = self._from_interchange_state(self.state)
             scattered = True
             self._build_steps()
         except Exception:
@@ -2126,9 +2193,9 @@ class Trainer:
                 # the new layout's scatter succeeded before the failure;
                 # gather back to the interchange form under the NEW
                 # reducer before the old one re-scatters it
-                self.state = self._to_checkpoint_state(self.state)
+                self.state = self._to_interchange_state(self.state)
             self.reducer = old
-            self.state = self._from_checkpoint_state(self.state)
+            self.state = self._from_interchange_state(self.state)
             self._build_steps()
             raise
         self._sync_schedule_gauge()
@@ -2458,16 +2525,6 @@ class Trainer:
                 "(--dcn-slices > 1) and no sequence parallelism; "
                 f"got dcn={self.dcn_size}, seq={self.seq_size}"
             )
-        if cfg.comm_op == "rs_fwd_ag" and jax.process_count() > 1:
-            # the cross-step carry's interchange form (checkpoints, eval,
-            # autotune swaps) gathers shards host-side, which needs every
-            # buffer locally addressable; multi-host needs a collective
-            # gather seam first (ROADMAP follow-up)
-            raise ValueError(
-                "--comm-op rs_fwd_ag is single-process (multi-device) for "
-                "now: the cross-step param carry's host gather/scatter is "
-                "not multi-host capable yet"
-            )
         if cfg.policy in ("none", "xla"):
             if cfg.comm_op in ("rs_opt_ag", "rs_fwd_ag"):
                 # the sharded optimizer NEEDS the bucketed lowering (it
@@ -2693,9 +2750,7 @@ class Trainer:
 
         if isinstance(params, ShardedParams):
             # the benchmark forwards the canonical tree on ONE device
-            params = self.reducer.optim.gather_params(
-                params, self._params_template
-            )
+            params = self._gathered_params(params)
         try:
             tf = benchmark_trainer_forward(
                 self.model, self.meta, params, self.state.batch_stats,
@@ -3279,11 +3334,7 @@ class Trainer:
                 float(step if step is not None else -1)
             ))
             step = None if step < 0 else step
-        snap = self.checkpointer.restore(
-            self._replicated_template_state(),
-            step=step,
-            carry_template=self._carry_template(),
-        )
+        snap = self._restore_step(self.checkpointer, step)
         if snap is None:  # GC'd between check and restore — give up cleanly
             raise RuntimeError(
                 "rollback requested but the checkpoint vanished"
@@ -3332,12 +3383,12 @@ class Trainer:
 
     def _eval_params(self):
         """The canonical replicated params for host/eval consumers: the
-        live tree, or the cross-step carry gathered back into it."""
+        live tree, or the cross-step carry gathered back into it (a
+        collective all-gather on a multi-host mesh — the one place the
+        replicated view is genuinely needed)."""
         if not self._cross_step:
             return self.state.params
-        return self.reducer.optim.gather_params(
-            self.state.params, self._params_template
-        )
+        return self._gathered_params(self.state.params)
 
     def _eval_state(self):
         """State view eval steps consume: replicated params (gathered from
@@ -3516,21 +3567,13 @@ class Trainer:
     def save(self, epoch: int) -> None:
         """Epoch-boundary checkpoint (step-indexed key = the iteration the
         epoch ended on; the sidecar index marks it a boundary)."""
-        if self.checkpointer is not None:
-            # sharded opt state is gathered to the replicated optax form on
-            # the way out: checkpoints stay interchangeable between comm
-            # paths, mesh extents, and merge schedules
-            self.checkpointer.save(
-                Snapshot(
-                    state=self._to_checkpoint_state(self.state),
-                    epoch=epoch,
-                    iteration=self.iteration,
-                )
-            )
-            self._emit_event(
-                "checkpoint", epoch=int(epoch),
-                iteration=int(self.iteration), mid_epoch=False,
-            )
+        if self.checkpointer is None:
+            return
+        stats = self._save_snapshot(epoch, epoch_step=0, mid_epoch=False)
+        self._emit_event(
+            "checkpoint", epoch=int(epoch),
+            iteration=int(self.iteration), mid_epoch=False, **stats,
+        )
 
     def save_step(
         self, epoch: int, epoch_step: int, wait: bool = False
@@ -3539,43 +3582,358 @@ class Trainer:
         preemption drain): carries the data-iterator position — the
         deterministic loader makes (epoch, epoch_step) the complete
         iterator state — and the BPTT carry for stateful models, so a
-        restart resumes from the EXACT step, bitwise."""
+        restart resumes from the EXACT step, bitwise — multi-host
+        included (the shard-native format writes each process's carry
+        block; the replicated escape hatch all-gathers it)."""
         if self.checkpointer is None:
             return
-        carry = None
-        if self.meta.has_carry and self.carry is not None:
-            if jax.process_count() > 1:
-                # the live carry is data-sharded across PROCESSES: no one
-                # process can materialize the layout-independent host
-                # form. Resume re-initializes the epoch's hidden state
-                # instead (ROADMAP names the carry-allgather follow-up);
-                # params/opt state stay exact. Warn once, not per save.
-                if not getattr(self, "_warned_no_carry_ckpt", False):
-                    self._warned_no_carry_ckpt = True
-                    self.log.warning(
-                        "multi-host: BPTT carry not checkpointed; a "
-                        "resume restarts this epoch's hidden state from "
-                        "zeros"
-                    )
-            else:
-                # host-materialize: the live carry is sharded over the
-                # data axis; the checkpoint form must be layout-independent
-                carry = jax.tree_util.tree_map(np.asarray, self.carry)
-        self.checkpointer.save(
-            Snapshot(
-                state=self._to_checkpoint_state(self.state),
-                epoch=epoch,
-                iteration=self.iteration,
-                epoch_step=epoch_step,
-                mid_epoch=True,
-                carry=carry,
-            ),
-            wait=wait,
+        stats = self._save_snapshot(
+            epoch, epoch_step=epoch_step, mid_epoch=True, wait=wait,
         )
         self._emit_event(
             "checkpoint", epoch=int(epoch), iteration=int(self.iteration),
-            mid_epoch=True, epoch_step=int(epoch_step),
+            mid_epoch=True, epoch_step=int(epoch_step), **stats,
         )
+
+    # -- snapshot writers (shard-native by default) ----------------------
+    def _ckpt_sharded(self) -> bool:
+        """Shard-native format unless the --ckpt-format replicated escape
+        hatch (interchange with pre-ISSUE-13 consumers) is armed."""
+        return getattr(self.config, "ckpt_format", "sharded") != "replicated"
+
+    def _save_snapshot(
+        self, epoch: int, epoch_step: int, mid_epoch: bool,
+        wait: bool = False,
+    ) -> dict:
+        """Write one snapshot in the configured format; returns the
+        telemetry fields for the `checkpoint` event (save duration +
+        bytes this process wrote — the flight recorder and report tool
+        surface checkpoint-cost regressions from them)."""
+        carry = None
+        if self.meta.has_carry and self.carry is not None and mid_epoch:
+            carry = self.carry
+        if self._ckpt_sharded():
+            manifest, files = self._shard_payload(
+                epoch, epoch_step, mid_epoch, carry
+            )
+            stats = self.checkpointer.save_sharded(
+                manifest, files, wait=wait
+            )
+            return {
+                "duration_s": float(stats["duration_s"]),
+                "bytes": int(stats["bytes"]),
+                "format": "sharded",
+            }
+        # --ckpt-format replicated: the legacy orbax payload (gathered
+        # interchange form; duration measures the submit — orbax commits
+        # asynchronously unless wait=True)
+        t0 = time.perf_counter()
+        host_carry = None
+        if carry is not None:
+            host_carry = jax.tree_util.tree_map(
+                np.asarray, self._replicated_view(carry)
+            )
+        state = self._to_interchange_state(self.state)
+        nbytes = int(sum(
+            np.dtype(leaf.dtype).itemsize
+            * (int(np.prod(leaf.shape)) if leaf.shape else 1)
+            for leaf in jax.tree_util.tree_leaves(state)
+            if hasattr(leaf, "dtype")
+        ))
+        self.checkpointer.save(
+            Snapshot(
+                state=state,
+                epoch=epoch,
+                iteration=self.iteration,
+                epoch_step=epoch_step,
+                mid_epoch=mid_epoch,
+                carry=host_carry,
+            ),
+            wait=wait,
+        )
+        return {
+            "duration_s": float(time.perf_counter() - t0),
+            "bytes": nbytes,
+            "format": "replicated",
+        }
+
+    def _replicated_view(self, tree):
+        """A fully-addressable (replicated) view of a data-sharded pytree
+        — identity on one process, a cached jitted all-gather on a
+        multi-host mesh (the collective twin of np.asarray; shared
+        implementation in `mesh.gather_replicated`)."""
+        if jax.process_count() == 1:
+            return tree
+        from mgwfbp_tpu.parallel.mesh import gather_replicated
+
+        return gather_replicated(
+            tree, self.mesh, self.__dict__.setdefault("_rep_progs", {})
+        )
+
+    # -- shard-native payload builders (ISSUE 13) ------------------------
+    def _tree_leaf_docs(self, tree) -> list[dict]:
+        from mgwfbp_tpu.checkpoint import _leaf_doc
+
+        return [
+            _leaf_doc(jax.tree_util.keystr(kp), leaf)
+            for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+        ]
+
+    def _shard_rows_by_process(self) -> dict[int, list[int]]:
+        """Global shard-row ownership: row -> lowest-index process whose
+        devices hold it (the save-side dedup rule; identical on every
+        process — it derives from the mesh alone)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        optim = self.reducer.optim
+        sharding = NamedSharding(self.mesh, P(optim.axes))
+        owners: dict[int, int] = {}
+        for dev, idx in sharding.devices_indices_map(
+            (optim.world, 1)
+        ).items():
+            r = int(idx[0].start or 0)
+            p = int(dev.process_index)
+            if r not in owners or p < owners[r]:
+                owners[r] = p
+        rows: dict[int, list[int]] = {}
+        for r, p in owners.items():
+            rows.setdefault(p, []).append(r)
+        return {p: sorted(v) for p, v in rows.items()}
+
+    def _local_needed_rows(self) -> list[int]:
+        """Shard rows this process's devices materialize at restore time
+        (the superset of its save-side owned rows when an axis outside
+        the shard spec replicates them)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        optim = self.reducer.optim
+        sharding = NamedSharding(self.mesh, P(optim.axes))
+        rows = set()
+        for _, idx in sharding.addressable_devices_indices_map(
+            (optim.world, 1)
+        ).items():
+            rows.add(int(idx[0].start or 0))
+        return sorted(rows)
+
+    @staticmethod
+    def _rows_block(arr, rows: list[int]) -> np.ndarray:
+        """Stack the requested global rows of a (world, shard) array from
+        this process's addressable shards — only those rows' bytes are
+        touched."""
+        want = set(rows)
+        have: dict[int, np.ndarray] = {}
+        for sh in arr.addressable_shards:
+            start = int(sh.index[0].start or 0)
+            nrows = int(sh.data.shape[0])
+            if want.intersection(range(start, start + nrows)):
+                data = np.asarray(sh.data)
+                for k in range(nrows):
+                    if start + k in want:
+                        have[start + k] = data[k]
+        return np.stack([have[r] for r in rows])
+
+    def _carry_runs_by_process(
+        self, rows: int
+    ) -> dict[int, list[list[int]]]:
+        """EXACT batch-row runs each process's devices own on the carry's
+        dim-0 data sharding (lowest-index owner dedup, adjacent runs
+        merged). A process's rows need not be contiguous — a multi-slice
+        (dcn) data sharding interleaves them — so both the manifest
+        (save) and the restore-side block assembly use this run list
+        verbatim; a contiguous-block assumption would silently assign
+        hidden-state rows to the wrong batch elements."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # only dim 0 is sharded; a 1-D probe shape yields the same runs
+        # for every carry leaf regardless of its rank
+        sharding = NamedSharding(self.mesh, P(self.data_axes))
+        owners: dict[int, tuple[int, int]] = {}  # start -> (proc, stop)
+        for dev, idx in sharding.devices_indices_map((rows,)).items():
+            a = int(idx[0].start or 0)
+            b = int(idx[0].stop if idx[0].stop is not None else rows)
+            p = int(dev.process_index)
+            if a not in owners or p < owners[a][0]:
+                owners[a] = (p, b)
+        runs: dict[int, list[list[int]]] = {}
+        for a in sorted(owners):
+            p, b = owners[a]
+            mine = runs.setdefault(p, [])
+            if mine and mine[-1][1] == a:
+                mine[-1][1] = b  # merge adjacent
+            else:
+                mine.append([a, b])
+        return runs
+
+    @staticmethod
+    def _carry_block(leaf, runs: list[list[int]]) -> np.ndarray:
+        """This process's carry rows, run-concatenated in manifest order
+        — every requested row must be locally addressable."""
+        have: list[tuple[int, int, Any]] = []
+        for sh in leaf.addressable_shards:
+            a = int(sh.index[0].start or 0)
+            have.append((a, a + int(sh.data.shape[0]), sh))
+        pieces = []
+        for start, stop in runs:
+            pos = start
+            while pos < stop:
+                hit = None
+                for a, b, sh in have:
+                    if a <= pos < b:
+                        hit = (a, b, sh)
+                        break
+                if hit is None:
+                    raise RuntimeError(
+                        f"carry row {pos} is not addressable on this "
+                        "process — carry sharding drifted from the "
+                        "manifest convention"
+                    )
+                a, b, sh = hit
+                hi = min(b, stop)
+                pieces.append(np.asarray(sh.data)[pos - a : hi - a])
+                pos = hi
+        return np.concatenate(pieces) if len(pieces) > 1 else np.array(
+            pieces[0]
+        )
+
+    def _shard_payload(
+        self, epoch: int, epoch_step: int, mid_epoch: bool, carry,
+    ) -> tuple[dict, dict]:
+        """(manifest, this process's files) for one shard-native save.
+
+        Sharded sections (the rs_opt_ag opt slots, the rs_fwd_ag param
+        carry, the BPTT carry) contribute ONLY this process's shard rows;
+        replicated sections (params on in-step lowerings, batch stats,
+        the optax tree on unsharded runs, rng) are written once by
+        process 0."""
+        from mgwfbp_tpu.checkpoint import SHARD_FORMAT_VERSION
+        from mgwfbp_tpu.parallel.allreduce import (
+            _map_count_leaves,
+            _map_params_subtrees,
+        )
+
+        state = self.state
+        primary = coord.is_primary()
+        files: dict[str, np.ndarray] = {}
+        sharded = self._sharded_opt or self._cross_step
+        manifest: dict = {
+            "format_version": SHARD_FORMAT_VERSION,
+            "step": int(self.iteration),
+            "world": int(
+                self.reducer.optim.world if sharded
+                else self.data_size * self.seq_size
+            ),
+            "process_count": int(jax.process_count()),
+            "mesh_axes": {
+                str(k): int(v) for k, v in self.mesh.shape.items()
+            },
+            "comm_op": str(self.config.comm_op),
+            "leaves": self._tree_leaf_docs(self._params_template),
+            "rng": [int(x) for x in np.asarray(state.rng).reshape(-1)],
+            "meta": {
+                "epoch": int(epoch),
+                "iteration": int(self.iteration),
+                "epoch_step": int(epoch_step),
+                "mid_epoch": bool(mid_epoch),
+                "train_step": int(np.asarray(state.step)),
+                "steps_per_epoch": int(max(self._steps_per_epoch(), 1)),
+                "sched_step_offset": int(self._sched_step_offset),
+                "sched_epoch_offset": float(self._sched_epoch_offset),
+            },
+        }
+        rows_by_proc = None
+        if sharded:
+            optim = self.reducer.optim
+            rows_by_proc = self._shard_rows_by_process()
+            manifest["layout"] = optim.manifest_layout()
+            manifest["processes"] = {
+                str(p): {"rows": rows} for p, rows in rows_by_proc.items()
+            }
+            my_rows = rows_by_proc.get(jax.process_index(), [])
+            for s, groups in enumerate(state.opt_state.slots):
+                for gi, buf in enumerate(groups):
+                    files[f"opt.s{s}.g{gi}"] = self._rows_block(
+                        buf, my_rows
+                    )
+            manifest["opt"] = {
+                "kind": "sharded", "slots": int(optim.num_slots),
+            }
+            manifest["meta"]["opt_count"] = int(
+                np.asarray(state.opt_state.count)
+            )
+        if self._cross_step:
+            my_rows = rows_by_proc.get(jax.process_index(), [])
+            for gi, buf in enumerate(state.params.groups):
+                files[f"params.g{gi}"] = self._rows_block(buf, my_rows)
+            manifest["params"] = {"kind": "sharded"}
+        else:
+            manifest["params"] = {"kind": "replicated"}
+            if primary:
+                for j, leaf in enumerate(
+                    jax.tree_util.tree_leaves(state.params)
+                ):
+                    files[f"params.l{j}"] = np.asarray(leaf)
+        if not sharded:
+            opt_docs = self._tree_leaf_docs(state.opt_state)
+            # slot s of params-tree leaf j -> flat optax leaf index, so a
+            # SHARDED restore target can re-slice this replicated source
+            # without reconstructing the optax tree
+            n_opt = len(opt_docs)
+            idx_tree = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(state.opt_state),
+                list(range(n_opt)),
+            )
+            slot_leaf_index: list[list[int]] = []
+            _map_params_subtrees(
+                idx_tree, state.params,
+                lambda sub: slot_leaf_index.append(
+                    [int(i) for i in jax.tree_util.tree_leaves(sub)]
+                ) or sub,
+            )
+            counts: list[int] = []
+            _map_count_leaves(
+                state.opt_state,
+                lambda leaf: counts.append(int(np.asarray(leaf))) or leaf,
+            )
+            manifest["opt"] = {
+                "kind": "replicated",
+                "leaves": opt_docs,
+                "slot_leaf_index": slot_leaf_index,
+            }
+            manifest["meta"]["opt_count"] = int(counts[0]) if counts else 0
+            if primary:
+                for j, leaf in enumerate(
+                    jax.tree_util.tree_leaves(state.opt_state)
+                ):
+                    files[f"opt.l{j}"] = np.asarray(leaf)
+        manifest["batch_stats"] = {
+            "kind": "replicated",
+            "leaves": self._tree_leaf_docs(state.batch_stats),
+        }
+        if primary:
+            for j, leaf in enumerate(
+                jax.tree_util.tree_leaves(state.batch_stats)
+            ):
+                files[f"batch_stats.l{j}"] = np.asarray(leaf)
+        if carry is not None:
+            carry_leaves = jax.tree_util.tree_leaves(carry)
+            runs = self._carry_runs_by_process(
+                int(carry_leaves[0].shape[0])
+            )
+            manifest["carry"] = {
+                "leaves": self._tree_leaf_docs(carry),
+                # exact row runs per process, manifest-ordered — the
+                # reader maps any global row straight to (process,
+                # offset within that process's run-concatenated file)
+                "runs": {
+                    str(p): [[int(a), int(b)] for a, b in r]
+                    for p, r in runs.items()
+                },
+            }
+            mine = runs.get(jax.process_index())
+            if mine:
+                for li, leaf in enumerate(carry_leaves):
+                    files[f"carry.l{li}"] = self._carry_block(leaf, mine)
+        return manifest, files
 
     def close(self) -> None:
         if self.checkpointer is not None:
@@ -3653,9 +4011,15 @@ class Trainer:
         emits its own `rollback` record, and a `resume` row means "a
         restart picked up from a saved snapshot", which a rollback inside
         one uninterrupted process is not)."""
-        self.state = self._from_checkpoint_state(
-            self._replicate_onto_mesh(snap.state)
-        )
+        if snap.native:
+            # shard-native restore: the state is already in live form on
+            # this mesh (sharded leaves as global arrays) — replicating or
+            # re-scattering it would be wrong, not just wasteful
+            self.state = snap.state
+        else:
+            self.state = self._from_interchange_state(
+                self._replicate_onto_mesh(snap.state)
+            )
         self.iteration = snap.iteration
         if snap.mid_epoch:
             self.start_epoch = snap.epoch
@@ -3680,18 +4044,407 @@ class Trainer:
             else "",
         )
 
+    def _restore_step(self, ckpt, step: Optional[int]):
+        """Restore one step from `ckpt` by whatever path its format
+        wants: shard-native entries restore NATIVELY (each process reads
+        only its own/needed shard rows, re-sliced onto the live layout);
+        orbax entries ride the legacy template path."""
+        if step is None:
+            step = ckpt.latest_step()
+        if step is None:
+            return None
+        if ckpt.entry_format(step) == "sharded" and (
+            self._sharded_opt or self._cross_step
+        ):
+            return self._restore_native(ckpt, int(step))
+        # replicated target (or legacy payload): the template path's
+        # reconstruction is the replicated view the target needs anyway
+        snap = ckpt.restore(
+            self._replicated_template_state(),
+            step=int(step),
+            carry_template=self._carry_template(),
+        )
+        return self._localize_restored_carry(snap)
+
+    def _localize_restored_carry(self, snap):
+        """The template restore path hands back the carry with GLOBAL
+        batch rows; `train_epoch._globalize` expects THIS process's local
+        block on a multi-host mesh (native restores already produce it).
+        A row-count mismatch means the world changed — re-initialize the
+        epoch's hidden state, exactly the native path's rule."""
+        if (
+            snap is None or snap.carry is None
+            or jax.process_count() == 1 or not self.meta.has_carry
+        ):
+            return snap
+        template = self._carry_template()
+        local = int(jax.tree_util.tree_leaves(template)[0].shape[0])
+        have = int(jax.tree_util.tree_leaves(snap.carry)[0].shape[0])
+        if have != local * jax.process_count():
+            self.log.warning(
+                "carry in checkpoint covers %d global batch rows, this "
+                "run wants %d: re-initializing the epoch's hidden state "
+                "(params/opt state restore exactly)",
+                have, local * jax.process_count(),
+            )
+            snap.carry = None
+            return snap
+        my_runs = self._carry_runs_by_process(have).get(
+            jax.process_index(), []
+        )
+        if not my_runs:
+            snap.carry = None
+            return snap
+        snap.carry = jax.tree_util.tree_map(
+            lambda a: np.concatenate(
+                [np.asarray(a)[s:e] for s, e in my_runs]
+            )
+            if len(my_runs) != 1
+            else np.asarray(a)[my_runs[0][0] : my_runs[0][1]],
+            snap.carry,
+        )
+        return snap
+
+    def _restore_native(self, ckpt, step: int) -> Optional[Snapshot]:
+        """Shard-native restore onto the live sharded layout: per-leaf
+        re-slice from the manifest — works across world sizes, merge
+        schedules, and comm_ops without materializing a world-sized
+        buffer or a fully-replicated copy of any sharded leaf."""
+        from mgwfbp_tpu.parallel.allreduce import (
+            ShardedOptState,
+            ShardedParams,
+        )
+
+        src = ckpt.open_sharded(step)
+        mismatches = ckpt._diff_leaf_docs(
+            src.leaves, self._params_template, "params"
+        )
+        if mismatches:
+            from mgwfbp_tpu.checkpoint import CheckpointRestoreError
+
+            raise CheckpointRestoreError(
+                ckpt._drift_message(step, mismatches),
+                mismatches=mismatches,
+            )
+        optim = self.reducer.optim
+        dst = optim.manifest_layout()
+        dst_dtypes = [
+            np.dtype(jnp.dtype(d)) for d in dst["group_dtypes"]
+        ]
+        rows = self._local_needed_rows()
+        meta = src.meta
+        # optimizer slot-count drift fails HERE, named — not as a
+        # misleading missing-file error (too many slots) or a silent
+        # drop of saved state (too few)
+        src_kind = src.section_kind("opt")
+        if src_kind == "sharded":
+            src_slots = src.opt_slots()
+        else:
+            src_slots = len(
+                (src.manifest.get("opt") or {}).get("slot_leaf_index")
+                or []
+            )
+        if src_slots != optim.num_slots:
+            from mgwfbp_tpu.checkpoint import CheckpointRestoreError
+
+            raise CheckpointRestoreError(
+                f"cannot restore checkpoint step {step}: it carries "
+                f"{src_slots} optimizer slot(s) but the current "
+                f"optimizer uses {optim.num_slots} — optimizer config "
+                "drift (momentum/adam changed between the saving and "
+                "restoring run)"
+            )
+        # optimizer slots: re-sliced rows -> sharded global arrays
+        slots = []
+        for s in range(optim.num_slots):
+            bufs = src.read_rows(
+                "opt", s, dst["leaf_slots"], dst["shard_sizes"],
+                dst_dtypes, rows,
+            )
+            slots.append(tuple(
+                self._rows_to_global(
+                    bufs[gi], rows, optim.world, dst["shard_sizes"][gi],
+                )
+                for gi in range(len(bufs))
+            ))
+        count = jnp.asarray(int(meta.get("opt_count", 0)), jnp.int32)
+        opt_state = ShardedOptState(
+            count=self._replicate_onto_mesh(count), slots=tuple(slots),
+        )
+        # params: the cross-step carry re-slices like a slot; in-step
+        # lowerings keep the replicated tree
+        if self._cross_step:
+            bufs = src.read_rows(
+                "params", None, dst["leaf_slots"], dst["shard_sizes"],
+                dst_dtypes, rows,
+            )
+            params = ShardedParams(tuple(
+                self._rows_to_global(
+                    bufs[gi], rows, optim.world, dst["shard_sizes"][gi],
+                )
+                for gi in range(len(bufs))
+            ))
+        else:
+            treedef = jax.tree_util.tree_structure(self._params_template)
+            params = self._replicate_onto_mesh(
+                jax.tree_util.tree_unflatten(
+                    treedef,
+                    [
+                        src.read_leaf("params", j)
+                        for j in range(len(src.leaves))
+                    ],
+                )
+            )
+        # batch stats / rng / step counter: replicated bookkeeping
+        bs_docs = src.section_docs("batch_stats")
+        bs_diff = ckpt._diff_leaf_docs(
+            bs_docs, self.state.batch_stats, "batch_stats"
+        )
+        if bs_diff:
+            from mgwfbp_tpu.checkpoint import CheckpointRestoreError
+
+            raise CheckpointRestoreError(
+                ckpt._drift_message(step, bs_diff), mismatches=bs_diff
+            )
+        batch_stats = self._replicate_onto_mesh(
+            jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self.state.batch_stats),
+                [
+                    src.read_leaf("batch_stats", j)
+                    for j in range(len(bs_docs))
+                ],
+            )
+        )
+        rng = self.state.rng
+        if src.manifest.get("rng") is not None:
+            rng = self._replicate_onto_mesh(jnp.asarray(
+                np.asarray(src.manifest["rng"], np.uint32).reshape(
+                    rng.shape
+                ),
+                rng.dtype,
+            ))
+        state = self.state.replace(
+            step=self._replicate_onto_mesh(jnp.asarray(
+                int(meta.get("train_step", meta.get("iteration", step))),
+                self.state.step.dtype,
+            )),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+            rng=rng,
+        )
+        carry = self._native_carry(src)
+        entry = ckpt._index.get(str(step)) or ckpt._heal_sharded_entry(
+            step
+        )
+        return Snapshot(
+            state=state,
+            epoch=int(entry.get("epoch", meta.get("epoch", 0))),
+            iteration=int(meta.get("iteration", step)),
+            epoch_step=int(meta.get("epoch_step", 0)),
+            mid_epoch=bool(entry.get(
+                "mid_epoch", meta.get("mid_epoch", False)
+            )),
+            carry=carry,
+            native=True,
+            manifest_meta=meta,
+        )
+
+    def _rows_to_global(
+        self, block: np.ndarray, rows: list[int], world: int, shard: int,
+    ) -> jax.Array:
+        """Local (len(rows), shard) rows -> the (world, shard) global
+        array sharded P(axes) on the live mesh; each addressable device
+        gets exactly its row."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(self.reducer.optim.axes))
+        row_pos = {r: i for i, r in enumerate(rows)}
+        arrays = []
+        for dev, idx in sharding.addressable_devices_indices_map(
+            (world, shard)
+        ).items():
+            r = int(idx[0].start or 0)
+            arrays.append(
+                jax.device_put(block[row_pos[r]][None, :], dev)
+            )
+        return jax.make_array_from_single_device_arrays(
+            (world, shard), sharding, arrays
+        )
+
+    def _native_carry(self, src):
+        """This process's local carry block from a shard-native source,
+        or None when the model is carry-free, the save had none, or the
+        global batch changed (an elastic resize re-initializes the
+        epoch's hidden state — batch semantics changed with the world)."""
+        cdoc = src.carry_doc()
+        if cdoc is None or not self.meta.has_carry:
+            return None
+        template = self._carry_template()
+        t_leaves = jax.tree_util.tree_leaves(template)
+        mult = jax.process_count()
+        want_rows = int(t_leaves[0].shape[0]) * mult
+        have_rows = int(cdoc["leaves"][0]["shape"][0])
+        if want_rows != have_rows:
+            self.log.warning(
+                "carry in checkpoint covers %d global batch rows, the "
+                "resized run wants %d: re-initializing the epoch's "
+                "hidden state (params/opt state restore exactly)",
+                have_rows, want_rows,
+            )
+            return None
+        # this process's rows under the CURRENT sharding, in global
+        # order — the exact runs `_globalize` will lay back out (they
+        # interleave across processes on a multi-slice data sharding)
+        my_runs = self._carry_runs_by_process(want_rows).get(
+            jax.process_index(), []
+        )
+        if not my_runs:
+            return None
+
+        def read_leaf(li):
+            pieces = [
+                src.read_carry_range(li, a, b) for a, b in my_runs
+            ]
+            return (
+                np.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+            )
+
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template),
+            [read_leaf(li) for li in range(len(cdoc["leaves"]))],
+        )
+
     def _maybe_resume(self) -> None:
         snap = None
         if self.checkpointer is not None:
-            # checkpoints carry the replicated interchange form; restore
-            # into that template, then re-scatter for the sharded path
-            snap = self.checkpointer.restore(
-                self._replicated_template_state(),
-                carry_template=self._carry_template(),
-            )
+            snap = self._restore_step(self.checkpointer, None)
+        if snap is None and self.checkpointer is not None and (
+            _elastic_resume_enabled()
+        ):
+            # relaunched at a different world size under the supervisor's
+            # resize policy: the checkpoint lives under the OLD world's
+            # tag directory — find it and re-shard (ISSUE 13)
+            if self._resume_cross_world():
+                return
         if snap is not None:
             self._apply_snapshot(snap, "resumed")
             return
+        if self._pretrain_init():
+            return
+
+    # -- supervisor-driven elastic resize (ISSUE 13) ---------------------
+    def _sibling_resume_candidates(self) -> list[tuple[int, int, str]]:
+        """(latest step, world, tag dir name) for every sibling tag under
+        the checkpoint root that differs from this run's tag ONLY in its
+        worker count and has committed snapshots — the candidates a
+        resized relaunch may continue from."""
+        from mgwfbp_tpu.checkpoint import peek_steps
+
+        root = self.config.checkpoint_dir
+        own = self.config.tag()
+        parts = own.split("-")
+        try:
+            i = parts.index(f"n{self.data_size}")
+        except ValueError:
+            return []
+        out = []
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return []
+        for name in names:
+            q = name.split("-")
+            if len(q) != len(parts) or q[:i] != parts[:i] \
+                    or q[i + 1:] != parts[i + 1:]:
+                continue
+            if not (q[i].startswith("n") and q[i][1:].isdigit()):
+                continue
+            world = int(q[i][1:])
+            if world == self.data_size:
+                continue
+            steps = peek_steps(os.path.join(root, name))
+            if steps:
+                out.append((steps[-1], world, name))
+        return sorted(out)
+
+    def _resume_cross_world(self) -> bool:
+        """Resume from a sibling tag written at a DIFFERENT world size:
+        re-shard the snapshot onto the live layout (shard-native
+        manifests re-slice per leaf; legacy replicated payloads restore
+        through the template path, which is world-independent by
+        construction), continue the LR schedule from the manifest's
+        anchor, and record the transition as a `resize` event. Returns
+        True when a sibling snapshot was applied."""
+        best = self._sibling_resume_candidates()
+        step, old_world = (best[-1][0], best[-1][1]) if best else (-1, -1)
+        if coord.process_count() > 1:
+            # one agreed choice: the scan is filesystem state; process
+            # 0's answer is the group's answer
+            step = int(coord.broadcast_flag(float(step)))
+            old_world = int(coord.broadcast_flag(float(old_world)))
+        if step < 0 or old_world < 0:
+            return False
+        parts = self.config.tag().split("-")
+        i = parts.index(f"n{self.data_size}")
+        parts[i] = f"n{old_world}"
+        sibling = os.path.join(self.config.checkpoint_dir, "-".join(parts))
+        ckpt = Checkpointer(sibling)
+        try:
+            snap = self._restore_step(ckpt, step)
+        finally:
+            ckpt.close()
+        if snap is None:
+            return False
+        # continue the LR schedule from the OLD run's anchor: the
+        # step->epoch divisor may change with the world size, and the
+        # schedule must continue smoothly (exactly update_nworker's
+        # in-place arithmetic, reconstructed from the manifest)
+        meta = snap.manifest_meta or {}
+        old_nbpe = int(meta.get("steps_per_epoch", 0) or 0)
+        if old_nbpe > 0:
+            anchor_step = int(meta.get("sched_step_offset", 0))
+            anchor_epoch = float(meta.get("sched_epoch_offset", 0.0))
+            step_now = int(snap.iteration)
+            new_epoch_off = anchor_epoch + (
+                step_now - anchor_step
+            ) / old_nbpe
+            new_nbpe = max(self._steps_per_epoch(), 1)
+            if (
+                abs(new_epoch_off - step_now / new_nbpe) > 1e-12
+                or old_nbpe != new_nbpe
+            ):
+                self._sched_epoch_offset = new_epoch_off
+                self._sched_step_offset = step_now
+                self._build_optimizer()
+                # the sharded update interprets the OptimSpec baked into
+                # the reducer; same solve inputs -> same layout, so the
+                # restored shards stay valid under the rebuilt reducer
+                self.reducer = self._build_reducer(
+                    self._profile_backward_enabled
+                )
+                self._build_steps()
+        self._apply_snapshot(
+            snap, f"resumed after resize ({old_world} -> {self.data_size})"
+        )
+        self._emit_event(
+            "resize",
+            old_world=int(old_world),
+            new_world=int(self.data_size),
+            schedule_source="relaunch-reshard",
+            num_groups=(
+                self.reducer.layout.num_groups
+                if self.reducer is not None else 0
+            ),
+        )
+        self.log.warning(
+            "elastic resize: resumed iteration %d from %s (world %d -> "
+            "%d; state re-sharded onto the live layout)",
+            snap.iteration, sibling, old_world, self.data_size,
+        )
+        return True
+
+    def _pretrain_init(self) -> bool:
         if self.config.pretrain:
             # --pretrain initializes weights AND epoch/iter counters from
             # another run (reference dl_trainer.py:307-312 restores
@@ -3703,7 +4456,14 @@ class Trainer:
             if self._cross_step:
                 # the live params are the sharded carry; re-scatter the
                 # restored canonical tree onto it
-                pre_params = self.reducer.optim.scatter_params(pre_params)
+                if jax.process_count() > 1:
+                    pre_params = self.reducer.optim.scatter_params_onto(
+                        pre_params, self.mesh
+                    )
+                else:
+                    pre_params = self.reducer.optim.scatter_params(
+                        pre_params
+                    )
             self.state = self.state.replace(
                 step=pre.state.step,
                 params=pre_params,
@@ -3715,6 +4475,8 @@ class Trainer:
                 "initialized from pretrain dir %s (epoch %d, iter %d)",
                 self.config.pretrain, pre.epoch, pre.iteration,
             )
+            return True
+        return False
 
     def fit(self, num_epochs: Optional[int] = None) -> dict:
         """Run `num_epochs` epochs from wherever we are (resume-aware); with

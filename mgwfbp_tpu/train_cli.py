@@ -65,6 +65,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mid-epoch step-indexed checkpoint every N optimizer "
                         "steps (preemption-safe resume restarts from the "
                         "exact step; 0 = epoch boundaries only)")
+    p.add_argument("--ckpt-format", dest="ckpt_format", default=None,
+                   choices=["sharded", "replicated"],
+                   help="checkpoint payload format (default sharded): "
+                        "'sharded' saves each process's own shard rows + "
+                        "a manifest (no world-sized gather; restores "
+                        "re-shard onto any world size — the elastic-"
+                        "resize path); 'replicated' keeps the legacy "
+                        "orbax gathered form for interchange with old "
+                        "runs. Restore reads either format transparently")
     p.add_argument("--no-grad-guard", action="store_true",
                    help="disable the non-finite-gradient guard (by default "
                         "a NaN/inf gradient drops that update, emits a "
@@ -135,7 +144,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "rs_opt_ag whose param all-gather is deferred into "
                         "the next step's forward, hiding comm behind "
                         "forward compute too (params carried as 1/world "
-                        "shards; single-process only)")
+                        "shards; multi-host capable — checkpoints are "
+                        "shard-native)")
     p.add_argument("--dcn-slices", dest="dcn_slices", type=int, default=None,
                    help="slices of a multi-slice pod: adds an outer "
                         "data-parallel mesh axis whose collectives cross "
@@ -180,7 +190,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             "num_steps", "num_batches_per_epoch", "compressor", "density",
             "comm_op", "dcn_slices", "autotune_steps", "schedule_cache",
             "telemetry_dir", "ckpt_every_steps", "bad_step_limit",
-            "metrics_port",
+            "metrics_port", "ckpt_format",
         )
         if getattr(args, k, None) is not None
     }

@@ -224,6 +224,44 @@ def test_supervisor_resubmits_preempted_group(tmp_path):
     assert len(logs) == 4  # 2 procs x 2 incarnations
 
 
+def test_supervisor_resize_policy_relaunches_at_new_size(tmp_path):
+    """--resize-to M (ISSUE 13): a drained (rc 75) group relaunches at M
+    processes, with MGWFBP_ELASTIC_RESUME exported so the children may
+    resume from the old world's sibling tag."""
+    script = (
+        "import os, sys\n"
+        f"d = {str(tmp_path)!r}\n"
+        "n = os.environ['MGWFBP_NUM_PROCESSES']\n"
+        "pid = os.environ['MGWFBP_PROCESS_ID']\n"
+        "open(os.path.join(d, f'seen_n{n}_p{pid}_'\n"
+        "     + os.environ.get('MGWFBP_ELASTIC_RESUME', '0')), 'w')"
+        ".close()\n"
+        "flag = os.path.join(d, 'drained_' + pid)\n"
+        "if not os.path.exists(flag):\n"
+        "    open(flag, 'w').close()\n"
+        "    sys.exit(75)\n"
+        "sys.exit(0)\n"
+    )
+    sup = _stub_supervisor(
+        script, n=2, resize_to=1, sleep=lambda s: None,
+    )
+    assert sup.run() == 0
+    assert [r.returncodes for r in sup.results] == [[75, 75], [0]]
+    # first incarnation at 2 processes, second at 1, both elastic-enabled
+    seen = {os.path.basename(p) for p in glob.glob(str(tmp_path / "seen_*"))}
+    assert {"seen_n2_p0_1", "seen_n2_p1_1", "seen_n1_p0_1"} <= seen
+    # the fleet view records the completed transition
+    meta = sup._fleet_meta()
+    assert meta["resize"] == {
+        "from": 2, "to": 1, "state": "done", "triggered": False,
+    }
+
+
+def test_supervisor_resize_rejects_bad_target():
+    with pytest.raises(ValueError, match="resize_to"):
+        _stub_supervisor("raise SystemExit(0)", n=2, resize_to=0)
+
+
 def test_supervisor_backoff_is_bounded_exponential():
     sup = _stub_supervisor("raise SystemExit(0)", backoff_base_s=1.0,
                            backoff_max_s=5.0)
@@ -502,10 +540,10 @@ def test_two_process_training_losses_agree(tmp_path):
     )
 
 
-def _train_args(root, extra=()):
+def _train_args(root, extra=(), dnn="lenet", batch="8"):
     return [
-        "--dnn", "lenet", "--synthetic", "--no-profile-backward",
-        "--batch-size", "8", "--num-batches-per-epoch", "6",
+        "--dnn", dnn, "--synthetic", "--no-profile-backward",
+        "--batch-size", batch, "--num-batches-per-epoch", "6",
         "--max-epochs", "2", "--epochs", "2", "--seed", "7",
         "--logdir", os.path.join(root, "logs"),
         "--checkpoint-dir", os.path.join(root, "ckpt"),
@@ -513,7 +551,8 @@ def _train_args(root, extra=()):
     ]
 
 
-def _supervised_run(root, fault_plan, processes=2):
+def _supervised_run(root, fault_plan, processes=2, extra=(), dnn="lenet",
+                    batch="8"):
     from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
 
     env = dict(os.environ)
@@ -523,7 +562,8 @@ def _supervised_run(root, fault_plan, processes=2):
         "MGWFBP_FAULT_PLAN": fault_plan, "PYTHONPATH": REPO,
     })
     sup = Supervisor(
-        default_train_cmd(_train_args(root)), processes,
+        default_train_cmd(_train_args(root, extra, dnn=dnn, batch=batch)),
+        processes,
         backoff_base_s=0.2, log_dir=os.path.join(root, "sup"), env=env,
     )
     return sup, sup.run()
@@ -603,6 +643,136 @@ def test_two_process_preempt_resume_bitwise_under_supervisor(tmp_path):
         steps = [r["step"] for r in events_of(merged, "step")
                  if r["process"] == p]
         assert max(steps) == 12  # both incarnations on one timeline
+
+
+@pytest.mark.slow
+def test_two_process_rs_fwd_ag_preempt_resume_bitwise(tmp_path):
+    """The ISSUE 13 acceptance pin for cross-step pipelining at pod
+    scale: the rs_fwd_ag multi-host build refusal is GONE, and a
+    supervised 2-process rs_fwd_ag run preempted mid-epoch — with the
+    param carry living as in-flight 1/world shards — drains to a
+    shard-native checkpoint (each process saves only its own shard rows)
+    and resumes BITWISE identical to an uninterrupted 2-process run."""
+    extra = ("--comm-op", "rs_fwd_ag")
+    faulted = str(tmp_path / "faulted")
+    sup, rc = _supervised_run(faulted, "preempt@step=4,proc=1", extra=extra)
+    assert rc == 0
+    assert [r.returncodes for r in sup.results] == [[75, 75], [0, 0]]
+
+    clean = str(tmp_path / "clean")
+    sup2, rc2 = _supervised_run(clean, "", extra=extra)
+    assert rc2 == 0 and len(sup2.results) == 1
+
+    # the drained checkpoint really is shard-native and per-process
+    (tagdir,) = glob.glob(os.path.join(faulted, "ckpt", "*"))
+    manifests = glob.glob(
+        os.path.join(tagdir, "sharded", "*", "manifest.json")
+    )
+    assert manifests, "rs_fwd_ag drain did not commit shard-native"
+    with open(sorted(manifests)[0]) as f:
+        manifest = json.load(f)
+    assert manifest["params"]["kind"] == "sharded"  # the in-flight carry
+    assert sorted(manifest["processes"]) == ["0", "1"]
+
+    a, b = _final_snapshot(faulted), _final_snapshot(clean)
+    assert a.iteration == b.iteration == 12
+    import jax
+
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state.params),
+        jax.tree_util.tree_leaves(b.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state.opt_state),
+        jax.tree_util.tree_leaves(b.state.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _final_carry_snapshot(root, dnn, batch):
+    import jax
+    import jax.numpy as jnp
+
+    from mgwfbp_tpu import models as zoo
+    from mgwfbp_tpu.checkpoint import Checkpointer
+    from mgwfbp_tpu.config import make_config
+    from mgwfbp_tpu.optim import make_optimizer
+    from mgwfbp_tpu.train.step import create_train_state
+
+    cfg = make_config(dnn, batch_size=int(batch), max_epochs=2, seed=7)
+    model, meta = zoo.create_model(dnn, dataset=cfg.dataset)
+    tx, _ = make_optimizer(
+        cfg.lr, dataset=cfg.dataset, max_epochs=2,
+        num_batches_per_epoch=6, lr_schedule=cfg.lr_schedule,
+        momentum=cfg.momentum, weight_decay=cfg.weight_decay,
+        norm_clip=cfg.norm_clip,
+    )
+    template = create_train_state(
+        jax.random.PRNGKey(7), model,
+        jnp.zeros((1,) + meta.input_shape, meta.input_dtype), tx,
+    )
+    (ckdir,) = glob.glob(os.path.join(root, "ckpt", "*"))
+    ck = Checkpointer(ckdir)
+    try:
+        carry_template = None
+        if meta.has_carry:
+            # the boundary snapshot carries no mid-epoch carry; a
+            # template covering the worst case keeps restore happy
+            import numpy as _np
+
+            carry_template = jax.tree_util.tree_map(
+                _np.asarray, model.initial_carry(int(batch) * 8)
+            )
+        return ck.restore(template, carry_template=carry_template)
+    finally:
+        ck.close()
+
+
+@pytest.mark.slow
+def test_two_process_carry_model_preempt_resume_bitwise(tmp_path):
+    """ISSUE 13 closes the multi-host BPTT-carry degrade path: a
+    2-process CARRY-MODEL (lstm) run preempted MID-EPOCH checkpoints
+    each process's carry batch rows shard-native, and the resumed run's
+    final params are BITWISE identical to an uninterrupted 2-process run
+    — possible only if the restored hidden state matched exactly (the
+    carry feeds every subsequent step)."""
+    dnn, batch = "lstm", "4"
+    faulted = str(tmp_path / "faulted")
+    sup, rc = _supervised_run(
+        faulted, "preempt@step=4,proc=1", dnn=dnn, batch=batch,
+    )
+    assert rc == 0
+    assert [r.returncodes for r in sup.results] == [[75, 75], [0, 0]]
+
+    clean = str(tmp_path / "clean")
+    sup2, rc2 = _supervised_run(clean, "", dnn=dnn, batch=batch)
+    assert rc2 == 0 and len(sup2.results) == 1
+
+    # the drained mid-epoch step really carried per-process carry blocks
+    (tagdir,) = glob.glob(os.path.join(faulted, "ckpt", "*"))
+    carry_manifests = []
+    for m in glob.glob(os.path.join(tagdir, "sharded", "*", "manifest.json")):
+        with open(m) as f:
+            doc = json.load(f)
+        if doc.get("carry"):
+            carry_manifests.append(doc)
+    assert carry_manifests, "no shard-native step carried the BPTT carry"
+    assert any(
+        sorted(doc["carry"]["runs"]) == ["0", "1"]
+        for doc in carry_manifests
+    ), "carry not saved by BOTH processes"
+
+    a = _final_carry_snapshot(faulted, dnn, batch)
+    b = _final_carry_snapshot(clean, dnn, batch)
+    assert a.iteration == b.iteration == 12
+    import jax
+
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state.params),
+        jax.tree_util.tree_leaves(b.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
 @pytest.mark.slow

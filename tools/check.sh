@@ -7,28 +7,33 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/6] ruff =="
+echo "== [1/7] ruff =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mgwfbp_tpu tests tools bench.py || rc=1
 else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/6] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
+echo "== [2/7] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
 JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
 
-echo "== [3/6] telemetry report smoke (writer -> report -> exports) =="
+echo "== [3/7] telemetry report smoke (writer -> report -> exports) =="
 JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
 
-echo "== [4/6] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
+echo "== [4/7] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py || rc=1
 
-echo "== [5/6] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
+echo "== [5/7] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
 # hard timeout: a coordination bug's failure mode is a distributed HANG —
 # and so is a fleet fan-in bug's — which must fail the gate, not wedge it
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --processes 2 || rc=1
 
-echo "== [6/6] tier-1 tests =="
+echo "== [6/7] elastic-resize smoke (supervisor-triggered drain -> relaunch at 1 process from the shard-native checkpoint -> resume to completion) =="
+# same hard-timeout contract: a resize hang (re-shard deadlock, a child
+# that never finds the sibling checkpoint) must FAIL the gate, not wedge it
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --resize || rc=1
+
+echo "== [7/7] tier-1 tests =="
 t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
 trap 'rm -f "$t1log"' EXIT                 # must not clobber each other
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -379,14 +379,181 @@ def multi_process(processes: int) -> dict:
         }
 
 
+def resize_smoke(processes: int = 2, resize_to: int = 1) -> dict:
+    """Elastic-resize lifecycle (ISSUE 13): a 2-process supervised group
+    with ``--resize-to 1`` — the supervisor must TRIGGER the drain itself
+    (SIGTERM once a child reports a completed step over /status), both
+    processes must exit rc 75 with shard-native checkpoints committed
+    exactly-once, the relaunched 1-process incarnation must find the
+    2-process world's checkpoint under its sibling tag, re-shard it onto
+    the new layout, emit the ``resize`` telemetry event, resume from the
+    exact drained step, and finish — and the merged timeline across BOTH
+    world sizes must stay monotonic. A hang anywhere fails check.sh's
+    hard-timeout stage."""
+    import threading
+
+    from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
+    from mgwfbp_tpu.telemetry import events_of, find_stream_paths
+    from telemetry_merge import check_monotonic, merge_streams
+
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_resize_smoke_") as d:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MGWFBP_HOST_DEVICES"] = "4"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # the stall holds the run open so the supervisor's /status poll
+        # reliably sees a completed step before the group finishes; the
+        # drain itself comes from the supervisor, not the plan
+        env["MGWFBP_FAULT_PLAN"] = "stall@secs=4,step=2"
+        base_port = _free_port()
+        env["MGWFBP_METRICS_PORT"] = str(base_port)
+        fleet_port = _free_port()
+        # rs_opt_ag: the opt state lives as 1/world shards — exactly the
+        # state the shard-native format exists for; the 2-process save
+        # must write per-process subtrees and the 1-process restore must
+        # re-slice them, never a world-sized gather
+        sup = Supervisor(
+            default_train_cmd(_cli(d)[3:] + ["--comm-op", "rs_opt_ag"]),
+            processes,
+            backoff_base_s=0.2,
+            log_dir=os.path.join(d, "supervisor"),
+            env=env,
+            fleet_port=fleet_port,
+            resize_to=resize_to,
+        )
+        rc_box: dict = {}
+        runner = threading.Thread(
+            target=lambda: rc_box.update(rc=sup.run()), daemon=True
+        )
+        runner.start()
+        # the transition is fleet-visible while it happens
+        fleet_resize = None
+        deadline = time.monotonic() + 560
+        while runner.is_alive() and time.monotonic() < deadline:
+            if fleet_resize is None:
+                code, body = _probe(
+                    fleet_port, "/fleet/status", timeout_s=10.0
+                )
+                if code == 200:
+                    doc = json.loads(body)
+                    if doc.get("resize"):
+                        fleet_resize = doc["resize"]
+            time.sleep(0.1)
+        runner.join(timeout=600)
+        assert not runner.is_alive(), "supervised resize group wedged"
+        rc = rc_box.get("rc")
+        assert rc == 0, f"supervised resize finished rc {rc}, want 0"
+        assert len(sup.results) == 2, (
+            f"expected drain + 1 resized incarnation, got "
+            f"{[r.returncodes for r in sup.results]}"
+        )
+        assert sup.results[0].preempted, sup.results[0]
+        assert len(sup.results[0].returncodes) == processes
+        assert sup.results[1].ok, sup.results[1]
+        assert len(sup.results[1].returncodes) == resize_to, (
+            "resized incarnation launched at the wrong size:"
+            f" {sup.results[1]}"
+        )
+        assert fleet_resize is not None, (
+            "/fleet/status never surfaced the resize view"
+        )
+        assert fleet_resize["from"] == processes, fleet_resize
+        assert fleet_resize["to"] == resize_to, fleet_resize
+
+        # telemetry: streams from BOTH world sizes merge into one
+        # monotonic timeline; the resized run records the transition
+        tag_dirs = sorted(
+            p for p in glob.glob(os.path.join(d, "*"))
+            if os.path.isdir(p) and find_stream_paths(p)
+        )
+        assert len(tag_dirs) == 2, (
+            f"expected one tag dir per world size, got {tag_dirs}"
+        )
+        paths = [p for t in tag_dirs for p in find_stream_paths(t)]
+        assert len(paths) == processes + resize_to, paths
+        merged = merge_streams(paths)
+        check_monotonic(merged)
+        resizes = events_of(merged, "resize")
+        assert resizes, "no resize telemetry event recorded"
+        rz = resizes[-1]
+        assert rz["old_world"] == processes * 4, rz
+        assert rz["new_world"] == resize_to * 4, rz
+        assert rz["schedule_source"] == "relaunch-reshard", rz
+        pre = events_of(merged, "preempt")
+        assert len(pre) == processes, pre
+        drained_iter = pre[0]["iteration"]
+        assert all(r["iteration"] == drained_iter for r in pre), pre
+        resumes = events_of(merged, "resume")
+        assert resumes and resumes[-1]["iteration"] == drained_iter, (
+            f"resumed at {resumes}, drained at {drained_iter}"
+        )
+        steps = [r["step"] for r in events_of(merged, "step")]
+        assert max(steps) == 12, (
+            f"resized run stopped at step {max(steps)}, want 12"
+        )
+        # shard-native payload really is per-process: the 2-process
+        # world's committed step holds one subtree PER PROCESS whose
+        # files carry exactly that process's shard rows — nothing
+        # world-sized anywhere on disk
+        n8_tag = [
+            t for t in glob.glob(os.path.join(d, "ckpt", "*"))
+            if "-n8-" in os.path.basename(t)
+        ]
+        assert n8_tag, os.listdir(os.path.join(d, "ckpt"))
+        shard_steps = glob.glob(
+            os.path.join(n8_tag[0], "sharded", "*", "manifest.json")
+        )
+        assert shard_steps, "2-process run committed no shard-native step"
+        import numpy as _np
+
+        with open(shard_steps[-1]) as f:
+            manifest = json.load(f)
+        rows = {
+            p: doc["rows"] for p, doc in manifest["processes"].items()
+        }
+        assert sorted(r for v in rows.values() for r in v) == list(
+            range(manifest["world"])
+        ), rows
+        step_dir = os.path.dirname(shard_steps[-1])
+        for p, prows in rows.items():
+            pdir = os.path.join(step_dir, f"p{int(p):05d}")
+            for gi, shard in enumerate(manifest["layout"]["shard_sizes"]):
+                arr = _np.load(
+                    os.path.join(pdir, f"opt.s0.g{gi}.npy"), mmap_mode="r"
+                )
+                assert arr.shape == (len(prows), shard), (
+                    p, gi, arr.shape, (len(prows), shard),
+                )
+        return {
+            "fault_smoke": "ok",
+            "mode": "resize",
+            "incarnations": [r.returncodes for r in sup.results],
+            "drained_iteration": drained_iter,
+            "resize_event": {
+                k: rz[k] for k in (
+                    "old_world", "new_world", "schedule_source",
+                )
+            },
+            "fleet_resize_view": fleet_resize,
+            "merged_records": len(merged),
+        }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--processes", type=int, default=1,
                     help="1 = single-process lifecycle (default); >1 = "
                          "supervised multi-host group with an agreed "
                          "drain + auto-resubmit")
+    ap.add_argument("--resize", action="store_true",
+                    help="elastic-resize lifecycle: 2-process supervised "
+                         "group drained by the supervisor's --resize-to "
+                         "policy, relaunched at 1 process from the "
+                         "shard-native checkpoint, resumed to completion")
     args = ap.parse_args()
-    if args.processes > 1:
+    if args.resize:
+        out = resize_smoke(max(args.processes, 2), 1)
+    elif args.processes > 1:
         out = multi_process(args.processes)
     else:
         out = single_process()

@@ -202,7 +202,12 @@ def format_report(records: list[dict]) -> str:
             f"resize {r.get('old_world')} -> {r.get('new_world')} "
             f"({r.get('schedule_source')}, {r.get('num_groups')} groups)")),
         ("checkpoint", lambda r: (
-            f"checkpoint epoch {r.get('epoch')} iter {r.get('iteration')}")),
+            f"checkpoint epoch {r.get('epoch')} iter {r.get('iteration')}"
+            + (
+                f" [{r.get('format')} {_fmt_s(r.get('duration_s'))} s, "
+                f"{int(r.get('bytes', 0)) // 1024} KiB/proc]"
+                if r.get("duration_s") is not None else ""
+            ))),
         ("autotune_race", lambda r: (
             f"autotune race {r.get('label')}: "
             f"{_fmt_s(r.get('measured_step_s'))} s/step "
