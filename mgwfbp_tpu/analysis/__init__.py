@@ -1,25 +1,41 @@
-"""Static analysis for the MG-WFBP hot path.
+"""Static analysis for the MG-WFBP hot path AND the host-side protocol.
 
-Two passes, one CLI (`python -m mgwfbp_tpu.analysis`):
+Four passes, one CLI (`python -m mgwfbp_tpu.analysis`), cheapest first:
 
+  * `ast_lint` — AST rules for tracing-unsafe Python inside jitted code
+    (wall clocks, numpy RNG, host round-trips, Python branches on traced
+    values, mutable defaults, telemetry-in-jit). Rule ids JIT000..JIT006.
+  * `spmd_check` — the SPMD lockstep checker: statically proves the
+    host-side multi-host coordination protocol deadlock-free. Group
+    operations are discovered from the ``@group_op`` decorations in
+    `runtime/coordination.py`; interprocedural effect signatures +
+    a group-uniformity lattice enforce that every process executes the
+    identical group-op sequence. Rule ids RUN001..RUN006.
+  * ANA001 — annotation accounting (ruff's unused-noqa semantics): a
+    suppression or ``group-uniform`` marker that changes nothing, or a
+    RUN-family suppression without a reason, is itself an error.
   * `jaxpr_check` — trace the jitted train step on abstract inputs and
     verify the lowered program realizes the merge schedule (group count,
     bucket sizes/dtypes, no stray collectives or host callbacks, buffer
-    donation). Rule ids SCH001..SCH007.
-  * `ast_lint` — AST rules for tracing-unsafe Python inside jitted code
-    (wall clocks, numpy RNG, host round-trips, Python branches on traced
-    values, mutable defaults). Rule ids JIT000..JIT005.
+    donation, guard/health footprints). Rule ids SCH001..SCH010; a
+    failure to TRACE at all is TRC000 (exit bit 16), distinct from any
+    rule violation.
 
-Findings print as ``file:line RULE message``; suppress a lint finding
-in-line with ``# graft: noqa[RULE]``. See README "Static analysis".
+Exit codes are family-stable (rules.FAMILY_BITS): JIT=1, SCH=2, RUN=4,
+ANA=8, TRC=16. ``--json`` emits machine-readable findings. Findings
+print as ``file:line RULE message``; suppress in-line with
+``# graft: noqa[RULE] -- reason``. See README "Static analysis".
 """
 
 from mgwfbp_tpu.analysis.rules import (  # noqa: F401
     ERROR,
+    FAMILY_BITS,
     WARNING,
     Finding,
     Rule,
     RULES,
+    SuppressionTracker,
+    exit_code,
     filter_suppressed,
     has_errors,
     suppressed_ids,
@@ -28,6 +44,11 @@ from mgwfbp_tpu.analysis.ast_lint import (  # noqa: F401
     lint_file,
     lint_paths,
     lint_source,
+)
+from mgwfbp_tpu.analysis.spmd_check import (  # noqa: F401
+    check_paths,
+    check_sources,
+    discover_group_ops,
 )
 from mgwfbp_tpu.analysis.jaxpr_check import (  # noqa: F401
     collect_collectives,

@@ -1,18 +1,37 @@
-"""CLI: run both analysis passes and exit non-zero on errors.
+"""CLI: run the analysis passes and exit with a family-coded status.
 
-    python -m mgwfbp_tpu.analysis                 # lint package + verify step
-    python -m mgwfbp_tpu.analysis --skip-jaxpr    # AST lint only (fast)
+    python -m mgwfbp_tpu.analysis                 # lint + spmd + jaxpr
+    python -m mgwfbp_tpu.analysis --skip-jaxpr    # fast passes only
+    python -m mgwfbp_tpu.analysis --json          # machine-readable output
     python -m mgwfbp_tpu.analysis path/to/file.py # lint specific targets
 
-The jaxpr pass traces the jitted MG-WFBP train step on an 8-device virtual
-CPU mesh — pure tracing, no computation, no accelerator needed — once per
-merge policy, so the schedule-realization invariants are checked across the
-whole policy surface (wfbp / single / mgwfbp), not just the default.
+Pass order is cheapest-first so protocol bugs fail in seconds: the AST
+jit-safety lint, then the SPMD lockstep checker (RUN001..RUN006 over the
+multi-host protocol surfaces — runtime/, train/trainer.py,
+checkpoint.py, parallel/autotune.py, telemetry/drift.py), then ANA001
+(dead-suppression accounting over everything the first two passes saw),
+then the jaxpr pass, which traces the jitted MG-WFBP train step on an
+8-device virtual CPU mesh — pure tracing, no computation, no
+accelerator needed — once per merge policy, so the schedule-realization
+invariants are checked across the whole policy surface (wfbp / single /
+mgwfbp), not just the default.
+
+Exit codes are stable per rule family (CI can tell WHICH gate failed):
+bit 1 = JIT lint errors, bit 2 = SCH schedule-verifier errors, bit 4 =
+RUN lockstep errors, bit 8 = ANA annotation errors, bit 16 = the jaxpr
+pass failed to TRACE (TRC000 — a model/build failure, not a protocol
+violation). 0 = clean.
+
+``--json`` prints one JSON document on stdout: every finding (including
+suppressed ones, marked) with rule id, severity, file, line, message,
+and suppression state, plus the per-family error counts and the exit
+code the process will return.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -20,8 +39,8 @@ import sys
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mgwfbp_tpu.analysis",
-        description="MG-WFBP static analysis: jit-safety lint + "
-        "jaxpr merge-schedule verification",
+        description="MG-WFBP static analysis: jit-safety lint + SPMD "
+        "lockstep checker + jaxpr merge-schedule verification",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -29,8 +48,13 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--skip-lint", action="store_true",
                         help="skip the AST lint pass")
+    parser.add_argument("--skip-spmd", action="store_true",
+                        help="skip the SPMD lockstep pass (RUN rules)")
     parser.add_argument("--skip-jaxpr", action="store_true",
                         help="skip the jaxpr schedule-verification pass")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings on stdout "
+                        "(suppressed findings included, marked)")
     parser.add_argument("--model", default="lenet",
                         help="model to trace in the jaxpr pass")
     parser.add_argument(
@@ -54,24 +78,62 @@ def main(argv=None) -> int:
                         help="exit non-zero on warnings too")
     args = parser.parse_args(argv)
 
-    from mgwfbp_tpu.analysis.rules import ERROR, WARNING
+    from mgwfbp_tpu.analysis.rules import (
+        ERROR,
+        WARNING,
+        SuppressionTracker,
+        exit_code,
+        family,
+    )
 
+    tracker = SuppressionTracker()
     findings = []
+
     if not args.skip_lint:
         from mgwfbp_tpu.analysis.ast_lint import lint_paths
 
         targets = args.paths or [os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)
         ))]
-        findings.extend(lint_paths(targets))
+        findings.extend(lint_paths(targets, tracker))
+
+    if not args.skip_spmd:
+        from mgwfbp_tpu.analysis.spmd_check import check_paths
+
+        findings.extend(check_paths(tracker=tracker))
+
+    # ANA001 runs only when BOTH consuming passes ran: lint consumes JIT
+    # noqas, spmd consumes RUN noqas + group-uniform markers — skipping
+    # either would misreport that pass's live markers as dead
+    if not args.skip_lint and not args.skip_spmd:
+        findings.extend(tracker.unused_findings())
 
     if not args.skip_jaxpr:
-        from mgwfbp_tpu.analysis.jaxpr_check import verify_train_step
+        from mgwfbp_tpu.analysis.rules import Finding
+
+        def _trace(fn, *fargs, **fkw):
+            """One traced verification; a failure to trace is TRC000 —
+            CI must distinguish 'the model failed to build' from 'the
+            protocol/schedule is violated'."""
+            try:
+                return fn(*fargs, **fkw)
+            except Exception as e:  # noqa: BLE001 — uniform surface
+                return [Finding(
+                    "<jaxpr>", 0, "TRC000",
+                    f"{getattr(fn, '__name__', 'trace')}"
+                    f"{fargs!r} failed to trace: {type(e).__name__}: {e}",
+                )]
+
+        from mgwfbp_tpu.analysis.jaxpr_check import (
+            verify_health_stats_footprint,
+            verify_train_step,
+        )
 
         ops = [c.strip() for c in args.comm_ops.split(",") if c.strip()]
         for policy in [p.strip() for p in args.policies.split(",") if p.strip()]:
             for comm_op in ops:
-                findings.extend(verify_train_step(
+                findings.extend(_trace(
+                    verify_train_step,
                     args.model, policy, comm_op=comm_op,
                     # clipping on the sharded paths also verifies the
                     # declared clip-psum scope stays the only extra
@@ -83,33 +145,59 @@ def main(argv=None) -> int:
                 ))
         # one guard-off trace pins SCH008's other direction: disabling the
         # non-finite guard must actually remove the finite_check eqns
-        findings.extend(verify_train_step(
-            args.model, "wfbp", grad_guard=False,
+        findings.extend(_trace(
+            verify_train_step, args.model, "wfbp", grad_guard=False,
         ))
         # SCH010: the training-health statistics (ISSUE 12) must not
         # change the step's collective footprint — stats-on and stats-off
         # traces compared on the flat and the sharded-optimizer lowerings
         # (the two distinct collective shapes)
-        from mgwfbp_tpu.analysis.jaxpr_check import (
-            verify_health_stats_footprint,
-        )
-
         for comm_op in ("all_reduce", "rs_opt_ag"):
-            findings.extend(verify_health_stats_footprint(
+            findings.extend(_trace(
+                verify_health_stats_footprint,
                 args.model, "mgwfbp", comm_op=comm_op,
             ))
 
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = sum(1 for f in findings if f.severity == WARNING)
-    for f in findings:
-        print(f.format())
+    rc = exit_code(findings, args.warnings_as_errors)
+
+    if args.as_json:
+        def doc(f, suppressed):
+            return {
+                "rule": f.rule_id,
+                "family": family(f.rule_id),
+                "severity": f.severity,
+                "file": f.file,
+                "line": f.line,
+                "message": f.message,
+                "suppressed": suppressed,
+            }
+
+        by_family: dict[str, int] = {}
+        for f in findings:
+            if f.severity == ERROR:
+                fam = family(f.rule_id)
+                by_family[fam] = by_family.get(fam, 0) + 1
+        print(json.dumps({
+            "findings": (
+                [doc(f, False) for f in findings]
+                + [doc(f, True) for f in tracker.suppressed_findings]
+            ),
+            "errors": errors,
+            "warnings": warnings,
+            "errors_by_family": by_family,
+            "exit_code": rc,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
     print(
-        f"mgwfbp_tpu.analysis: {errors} error(s), {warnings} warning(s)",
+        f"mgwfbp_tpu.analysis: {errors} error(s), {warnings} warning(s)"
+        + (f", exit {rc}" if rc else ""),
         file=sys.stderr,
     )
-    if errors or (warnings and args.warnings_as_errors):
-        return 1
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -25,7 +25,11 @@ from __future__ import annotations
 import ast
 from typing import Optional, Sequence
 
-from mgwfbp_tpu.analysis.rules import Finding, filter_suppressed
+from mgwfbp_tpu.analysis.rules import (
+    Finding,
+    SuppressionTracker,
+    filter_suppressed,
+)
 
 # call names (rightmost dotted segment) whose first function-valued argument
 # becomes traced code
@@ -281,8 +285,14 @@ def _mutable_default_findings(
             ))
 
 
-def lint_source(source: str, path: str = "<string>") -> list:
-    """Lint one module's source; returns noqa-filtered findings."""
+def lint_source(
+    source: str, path: str = "<string>",
+    tracker: Optional[SuppressionTracker] = None,
+) -> list:
+    """Lint one module's source; returns noqa-filtered findings.
+    Consumed suppressions land on `tracker` (ANA001 accounting)."""
+    if tracker is not None:
+        tracker.scan_lines(path, source.splitlines())
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -314,10 +324,12 @@ def lint_source(source: str, path: str = "<string>") -> list:
                 visit_functions(child, inside_traced)
 
     visit_functions(tree, False)
-    return filter_suppressed(findings, source.splitlines())
+    return filter_suppressed(findings, source.splitlines(), tracker)
 
 
-def lint_file(path: str) -> list:
+def lint_file(
+    path: str, tracker: Optional[SuppressionTracker] = None
+) -> list:
     try:
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
@@ -325,10 +337,12 @@ def lint_file(path: str) -> list:
         return [Finding(path, 0, "JIT000", f"cannot read lint target: {e}")]
     except UnicodeDecodeError as e:
         return [Finding(path, 0, "JIT000", f"cannot decode lint target: {e}")]
-    return lint_source(source, path)
+    return lint_source(source, path, tracker)
 
 
-def lint_paths(paths: Sequence[str]) -> list:
+def lint_paths(
+    paths: Sequence[str], tracker: Optional[SuppressionTracker] = None
+) -> list:
     """Lint .py files (recursing into directories), sorted findings.
 
     A target that is neither a directory nor an existing .py file yields a
@@ -353,5 +367,5 @@ def lint_paths(paths: Sequence[str]) -> list:
                 "lint target is not a directory or existing .py file",
             ))
     for f in sorted(files):
-        findings.extend(lint_file(f))
+        findings.extend(lint_file(f, tracker))
     return findings

@@ -1,15 +1,29 @@
 """Rule registry, findings, and suppression for the static-analysis suite.
 
-Two rule families share this framework:
+Four rule families share this framework:
   * JIT0xx — AST lint rules for tracing-unsafe Python inside jitted/scanned
     code (`analysis.ast_lint`);
   * SCH0xx — jaxpr-level merge-schedule invariants checked against the
-    lowered train step (`analysis.jaxpr_check`).
+    lowered train step (`analysis.jaxpr_check`);
+  * RUN0xx — SPMD lockstep rules for the host-side multi-host coordination
+    protocol (`analysis.spmd_check`): every process must execute the
+    identical group-operation sequence, statically;
+  * ANA0xx — meta rules about the analysis annotations themselves
+    (a suppression that suppresses nothing, a suppression without a
+    reason).
+TRC000 is the odd one out: not a protocol violation but the jaxpr pass
+failing to TRACE the step at all — kept separate so CI can distinguish
+"the protocol is broken" from "the model failed to build".
 
 Findings print as ``file:line RULE message``. A finding on a source line
 carrying ``# graft: noqa`` (all rules) or ``# graft: noqa[JIT001]`` /
 ``# graft: noqa[JIT001,SCH004]`` (listed rules only) is suppressed —
 jaxpr-level findings have no meaningful source line and cannot be noqa'd.
+A suppression should carry a reason: ``# graft: noqa[RUN003] -- cadence
+vars are group-uniform (supervisor exports one env)``.
+
+Exit codes are stable per family (`FAMILY_BITS` / `exit_code`): CI can
+tell WHICH family failed from the code alone.
 """
 
 from __future__ import annotations
@@ -103,8 +117,73 @@ _register("SCH010", ERROR,
           "footprint (the stats must ride the EXISTING metrics psum — "
           "zero new collectives or host callbacks)")
 
+# --- SPMD lockstep rules (host-side multi-host protocol) --------------------
+_register("RUN001", ERROR,
+          "group operation control-dependent on a process-local value "
+          "(process identity, local RNG/clock/filesystem, a local flag) — "
+          "processes take different arms and the group deadlocks")
+_register("RUN002", ERROR,
+          "branch arms execute different group-operation sequences under a "
+          "condition not proven group-uniform (join-point sequence "
+          "mismatch)")
+_register("RUN003", ERROR,
+          "early return/raise/continue skips a group operation another "
+          "path still executes (the skipped-barrier hang)")
+_register("RUN004", ERROR,
+          "primary-only side effect (process-0-gated write) not followed "
+          "by a commit barrier / group operation on all paths — peers can "
+          "proceed before the commit is durable")
+_register("RUN005", ERROR,
+          "group operation inside a try whose handler swallows the "
+          "exception and proceeds — one process drops out of lockstep "
+          "while its peers wait")
+_register("RUN006", ERROR,
+          "blocking group operation reachable while holding a lock the "
+          "serving plane also takes (HTTP handler <-> step-loop deadlock)")
+
+# --- annotation meta rules --------------------------------------------------
+_register("ANA001", ERROR,
+          "dead or reason-less suppression: a '# graft: noqa[...]' that "
+          "suppresses nothing, a '# graft: group-uniform' the checker "
+          "never consulted, or a RUN-family suppression without a "
+          "'-- reason' string")
+
+# --- trace failures (not a protocol violation) ------------------------------
+_register("TRC000", ERROR,
+          "jaxpr pass could not trace the step (model/build failure — "
+          "distinct from a lint or schedule violation)")
+
+
+# exit-code bits, one per family: CI distinguishes WHICH gate failed from
+# the exit code alone (documented in README "Static analysis")
+FAMILY_BITS = {"JIT": 1, "SCH": 2, "RUN": 4, "ANA": 8, "TRC": 16}
+
+
+def family(rule_id: str) -> str:
+    return rule_id.rstrip("0123456789")
+
+
+def exit_code(
+    findings: Iterable[Finding], warnings_as_errors: bool = False
+) -> int:
+    """Bitwise-OR of the FAMILY_BITS of every error finding (warnings too
+    under `warnings_as_errors`); 0 when nothing qualifies."""
+    code = 0
+    for f in findings:
+        if f.severity == ERROR or warnings_as_errors:
+            code |= FAMILY_BITS.get(family(f.rule_id), 1)
+    return code
+
 
 _NOQA = re.compile(r"#\s*graft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s]+)\])?")
+# value annotation: the fact on this line the analysis cannot see — the
+# condition/assigned value IS group-uniform (see spmd_check). A reason
+# string after ' -- ' is required for RUN-family noqa and group-uniform
+# markers (ANA001 enforces it).
+_GROUP_UNIFORM = re.compile(r"#\s*graft:\s*group-uniform\b")
+_REASON = re.compile(
+    r"#\s*graft:\s*(?:noqa(?:\[[^\]]*\])?|group-uniform)\s*--\s*\S"
+)
 
 
 def suppressed_ids(source_line: str) -> Optional[frozenset[str]]:
@@ -122,15 +201,160 @@ def suppressed_ids(source_line: str) -> Optional[frozenset[str]]:
     return frozenset(s.strip() for s in ids.split(",") if s.strip())
 
 
+def has_group_uniform_marker(source_line: str) -> bool:
+    """True when the line carries a ``# graft: group-uniform`` value
+    annotation (spmd_check treats the condition/assigned value on that
+    line as group-uniform)."""
+    return _GROUP_UNIFORM.search(source_line) is not None
+
+
+def has_reason(source_line: str) -> bool:
+    """True when the line's graft marker carries a ``-- reason`` string."""
+    return _REASON.search(source_line) is not None
+
+
+def comment_lines(source: str) -> Optional[dict[int, str]]:
+    """{lineno: comment_text} for every REAL comment token — docstrings
+    quoting the annotation grammar must not register as markers. None
+    when the source does not tokenize (caller falls back to line scan).
+    """
+    import io
+    import tokenize
+
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            UnicodeDecodeError):
+        return None
+    return out
+
+
+class SuppressionTracker:
+    """Accounting for the annotation surface, feeding ANA001.
+
+    The passes report every suppression they CONSUME (`note_used`) and
+    every suppressed finding (kept, marked, for ``--json``); the tracker
+    independently scans the analyzed files for markers, so after all
+    passes ran, a marker nobody consumed is dead (`unused_findings`).
+    `note_uniform_used` is the same contract for ``group-uniform`` value
+    annotations (consumed by spmd_check when one actually informs a
+    classification).
+    """
+
+    def __init__(self) -> None:
+        # (file, line) -> frozenset of listed ids (empty = bare noqa)
+        self.markers: dict[tuple[str, int], frozenset[str]] = {}
+        # (file, line) of group-uniform value annotations
+        self.uniform_markers: set[tuple[str, int]] = set()
+        # (file, line) lines whose marker carries a reason string
+        self._reasoned: set[tuple[str, int]] = set()
+        # consumed: (file, line, rule_id) for noqa, (file, line) for uniform
+        self.used: set[tuple[str, int, str]] = set()
+        self.uniform_used: set[tuple[str, int]] = set()
+        self.suppressed_findings: list[Finding] = []
+        self._scanned: set[str] = set()
+
+    def scan_source(self, path: str, source: str) -> None:
+        if path in self._scanned:
+            return
+        self._scanned.add(path)
+        comments = comment_lines(source)
+        if comments is None:  # unparseable: every line is fair game
+            comments = dict(enumerate(source.splitlines(), start=1))
+        for i, line in comments.items():
+            ids = suppressed_ids(line)
+            if ids is not None:
+                self.markers[(path, i)] = ids
+            if has_group_uniform_marker(line):
+                self.uniform_markers.add((path, i))
+            if has_reason(line):
+                self._reasoned.add((path, i))
+
+    def scan_lines(self, path: str, source_lines: Sequence[str]) -> None:
+        self.scan_source(path, "\n".join(source_lines))
+
+    def scan_file(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                self.scan_source(path, f.read())
+        except (OSError, UnicodeDecodeError):
+            pass
+
+    def note_used(self, finding: Finding) -> None:
+        self.used.add((finding.file, finding.line, finding.rule_id))
+        self.suppressed_findings.append(finding)
+
+    def note_uniform_used(self, path: str, line: int) -> None:
+        self.uniform_used.add((path, line))
+
+    def unused_findings(self) -> list[Finding]:
+        """ANA001 findings: dead noqa ids, dead group-uniform markers, and
+        RUN-family / group-uniform markers without a reason string."""
+        out: list[Finding] = []
+        for (path, line), ids in sorted(self.markers.items()):
+            if ids:
+                dead = [
+                    rid for rid in sorted(ids)
+                    if (path, line, rid) not in self.used
+                ]
+                if dead:
+                    out.append(Finding(
+                        path, line, "ANA001",
+                        "noqa[" + ",".join(dead) + "] suppresses nothing "
+                        "on this line — remove the dead suppression",
+                    ))
+                if any(
+                    family(rid) == "RUN" for rid in ids
+                ) and (path, line) not in self._reasoned:
+                    out.append(Finding(
+                        path, line, "ANA001",
+                        "RUN-family suppression without a reason — append "
+                        "'-- <why this is safe>'",
+                    ))
+            else:
+                if not any(
+                    (f, ln) == (path, line) for (f, ln, _r) in self.used
+                ):
+                    out.append(Finding(
+                        path, line, "ANA001",
+                        "bare noqa suppresses nothing on this line — "
+                        "remove the dead suppression",
+                    ))
+        for (path, line) in sorted(self.uniform_markers):
+            if (path, line) not in self.uniform_used:
+                out.append(Finding(
+                    path, line, "ANA001",
+                    "group-uniform annotation the checker never consulted "
+                    "— remove it or move it to the condition/assignment "
+                    "it describes",
+                ))
+            elif (path, line) not in self._reasoned:
+                out.append(Finding(
+                    path, line, "ANA001",
+                    "group-uniform annotation without a reason — append "
+                    "'-- <why this value is identical on every process>'",
+                ))
+        return out
+
+
 def filter_suppressed(
-    findings: Iterable[Finding], source_lines: Sequence[str]
+    findings: Iterable[Finding],
+    source_lines: Sequence[str],
+    tracker: Optional[SuppressionTracker] = None,
 ) -> list[Finding]:
-    """Drop findings whose source line carries a matching noqa marker."""
+    """Drop findings whose source line carries a matching noqa marker;
+    consumed suppressions (and the findings they hid) are recorded on
+    `tracker` when given, so ANA001 can prove the rest dead."""
     out = []
     for f in findings:
         if 1 <= f.line <= len(source_lines):
             ids = suppressed_ids(source_lines[f.line - 1])
             if ids is not None and (not ids or f.rule_id in ids):
+                if tracker is not None:
+                    tracker.note_used(f)
                 continue
         out.append(f)
     return out

@@ -585,7 +585,7 @@ class Checkpointer:
         # write-temp + rename so a mid-write kill never corrupts the index
         live = {str(s) for s in self.all_steps()}
         self._index = {k: v for k, v in self._index.items() if k in live}
-        if not coord.is_primary():
+        if not coord.is_primary():  # graft: noqa[RUN004] -- the save paths commit-barrier after every sidecar write; the restore-path heal is an opportunistic p0 repair peers never read mid-restore
             # multi-host: exactly ONE writer for the sidecar — every
             # process keeps the same in-memory index (the save/restore
             # calls are collective), but two processes racing the
@@ -694,7 +694,16 @@ class Checkpointer:
             "has_carry": bool(manifest.get("carry")),
         }
         nbytes = int(sum(np.asarray(a).nbytes for a in files.values()))
-        if step in self.all_steps():
+        already = step in self.all_steps()
+        if coord.process_count() > 1:
+            # the dedup decision reads host-local filesystem state (the
+            # sidecar + shard dirs); a host with a torn local view taking
+            # the promote-only early path would skip the payload barrier
+            # its peers still enter (RUN003). Promote only when EVERY
+            # process sees the step committed; otherwise all re-save —
+            # the payload write is idempotent (tmp + os.replace)
+            already = coord.agree_all(already)
+        if already:
             prev = self._index.get(str(step), {})
             if prev:
                 # same dedup/promotion contract as the orbax path: the
@@ -732,32 +741,53 @@ class Checkpointer:
         # (the commit record) appears
         if coord.process_count() > 1:
             coord.barrier(f"ckpt_shard_payload_{step}")
-        if coord.is_primary():
-            mpath = os.path.join(step_dir, MANIFEST_FILE)
-            mtmp = mpath + ".tmp"
-            with open(mtmp, "w") as f:
-                json.dump(manifest, f)
-                if wait:
-                    f.flush()
-                    os.fsync(f.fileno())
-            os.replace(mtmp, mpath)
-        self._index[str(step)] = entry
-        self._gc()
-        self._write_index()
-        if wait and coord.is_primary():
-            # the COMMIT RECORD must be at least as durable as the
-            # payload it commits: flush the manifest's directory entry
-            # and the sidecar, or a power cut after the rc-75 exit can
-            # keep the payload while losing the fact it committed
-            _fsync_dir_files(step_dir)
-            try:
-                fd = os.open(self._index_path(), os.O_RDONLY)
+        # the window between the payload barrier and the commit barrier
+        # must stay BALANCED: if p0's manifest/sidecar write raised while
+        # its peers marched on to the commit barrier, they would wait out
+        # the full barrier timeout on a process that already unwound (the
+        # latent multi-host hang the SPMD checker's RUN003 formalizes).
+        # A local failure therefore becomes a GROUP decision: everyone
+        # agrees on commit success and everyone raises together.
+        commit_err: Optional[str] = None
+        try:
+            if coord.is_primary():
+                mpath = os.path.join(step_dir, MANIFEST_FILE)
+                mtmp = mpath + ".tmp"
+                with open(mtmp, "w") as f:
+                    json.dump(manifest, f)
+                    if wait:
+                        f.flush()
+                        os.fsync(f.fileno())
+                os.replace(mtmp, mpath)
+            self._index[str(step)] = entry
+            self._gc()
+            self._write_index()
+            if wait and coord.is_primary():
+                # the COMMIT RECORD must be at least as durable as the
+                # payload it commits: flush the manifest's directory entry
+                # and the sidecar, or a power cut after the rc-75 exit can
+                # keep the payload while losing the fact it committed
+                _fsync_dir_files(step_dir)
                 try:
-                    os.fsync(fd)
-                finally:
-                    os.close(fd)
-            except OSError:
-                pass
+                    fd = os.open(self._index_path(), os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                except OSError:
+                    pass
+        except (OSError, ValueError, TypeError) as e:
+            commit_err = f"{type(e).__name__}: {e}"
+        ok = commit_err is None
+        if coord.process_count() > 1:
+            ok = coord.agree_all(ok)
+        if not ok:
+            raise RuntimeError(
+                f"shard-native commit of step {step} failed "
+                f"({commit_err or 'on a peer process'}); no process "
+                "recorded the step as committed — restore falls back to "
+                "the previous checkpoint"
+            )
         self._commit_barrier(step)
         return {"duration_s": time.perf_counter() - t0, "bytes": nbytes}
 
@@ -784,7 +814,14 @@ class Checkpointer:
             "mid_epoch": bool(snap.mid_epoch),
             "has_carry": snap.carry is not None,
         }
-        if step in self.all_steps():
+        already = step in self.all_steps()
+        if coord.process_count() > 1:
+            # same contract as save_sharded: the dedup reads host-local
+            # filesystem state, and a split decision is a split save
+            # protocol (the promote path and the payload path issue
+            # different collective sequences) — agree before branching
+            already = coord.agree_all(already)
+        if already:
             prev = self._index.get(str(step), {})
             if prev:
                 # the stored payload is immutable (identical state), so

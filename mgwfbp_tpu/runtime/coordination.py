@@ -62,6 +62,42 @@ BARRIER_TIMEOUT_ENV = "MGWFBP_BARRIER_TIMEOUT_S"
 DEFAULT_BARRIER_TIMEOUT_S = 600.0
 
 
+# ---------------------------------------------------------------------------
+# group-operation registry
+# ---------------------------------------------------------------------------
+
+# name -> {"blocking": bool, "uniform_result": bool}. Populated by the
+# @group_op decorator below; the SPMD lockstep checker
+# (analysis/spmd_check.py) discovers its op list from these decorations —
+# the checker and the transport cannot drift, because a new primitive is
+# a new decoration, and the decoration IS the registration.
+GROUP_OPS: dict[str, dict] = {}
+
+
+def group_op(fn=None, *, blocking: bool = True, uniform_result: bool = True):
+    """Mark a function as a LOCKSTEP GROUP OPERATION: when
+    ``process_count() > 1`` every process must call it, in the same
+    order, with same-shaped payloads, or the group deadlocks.
+
+    ``blocking`` — the call cannot return until every process arrives
+    (true for every primitive here: psum/pmax rendezvous on the device,
+    barrier on the coordination service). ``uniform_result`` — the return
+    value is bitwise-identical on every process, so host decisions
+    branching on it keep the group in lockstep (the checker treats such
+    results as group-uniform sanitizers).
+    """
+    def register(f):
+        GROUP_OPS[f.__name__] = {
+            "blocking": bool(blocking),
+            "uniform_result": bool(uniform_result),
+        }
+        return f
+
+    if fn is not None:
+        return register(fn)
+    return register
+
+
 def process_count() -> int:
     return jax.process_count()
 
@@ -125,6 +161,7 @@ def _device_reduce(vals: Sequence[float], kind: str) -> np.ndarray:
 # agreement primitives
 # ---------------------------------------------------------------------------
 
+@group_op
 def agree_any(flag: bool) -> bool:
     """True everywhere iff ANY process passed True (preempt drain: one
     signaled host drains the whole group)."""
@@ -133,6 +170,7 @@ def agree_any(flag: bool) -> bool:
     return bool(_device_reduce([1.0 if flag else 0.0], "sum")[0] > 0.0)
 
 
+@group_op
 def agree_all(flag: bool) -> bool:
     """True everywhere iff EVERY process passed True (rollback: only when
     every host can restore; autotune cache hit: only when every host has
@@ -143,6 +181,7 @@ def agree_all(flag: bool) -> bool:
     return bool(total >= float(process_count()))
 
 
+@group_op
 def broadcast_flag(value: float, source: int = 0) -> float:
     """Process `source`'s scalar, identical everywhere (the tb-profile
     broadcast pattern, for host decisions: restore-target steps,
@@ -153,6 +192,7 @@ def broadcast_flag(value: float, source: int = 0) -> float:
     return float(_device_reduce([contrib], "sum")[0])
 
 
+@group_op
 def gather_values(value: float) -> list[float]:
     """Every process's scalar, in process order, identical everywhere
     (the live straggler probe: each process contributes its window step
@@ -166,6 +206,7 @@ def gather_values(value: float) -> list[float]:
     return [float(t) for t in _device_reduce(row, "sum")]
 
 
+@group_op
 def gather_vectors(values: Sequence[float]) -> list[list[float]]:
     """Every process's float VECTOR, in process order, identical
     everywhere — `gather_values` for per-group payloads (the on-demand
@@ -190,6 +231,7 @@ def gather_vectors(values: Sequence[float]) -> list[list[float]]:
     ]
 
 
+@group_op
 def agree_uniform(value: float) -> bool:
     """True iff every process passed the SAME scalar (max == min across
     the group). The cheap divergence guard for values that MUST be
@@ -205,6 +247,7 @@ def agree_uniform(value: float) -> bool:
     return mx == mn
 
 
+@group_op
 def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
     """Agreed argmin over per-candidate timings.
 
@@ -234,6 +277,7 @@ def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
 _barrier_seq: collections.Counter = collections.Counter()
 
 
+@group_op(uniform_result=False)
 def barrier(name: str, timeout_s: Optional[float] = None) -> None:
     """Named rendezvous across all processes, with a real timeout.
 

@@ -110,6 +110,7 @@ class Trainer:
         synthetic_data: Optional[bool] = None,
     ):
         self.config = config
+        # graft: group-uniform -- the mesh derives from config + the global device set, identical on every process
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshSpec(
                 data=-1, seq=config.seq_parallel, dcn=config.dcn_slices,
@@ -151,6 +152,7 @@ class Trainer:
             if config.dtype not in (None, "", "float32", "f32")
             else None
         )
+        # graft: group-uniform -- model + metadata derive from config alone
         self.model, self.meta = zoo.create_model(config.dnn, dataset=config.dataset)
         self._apply_lm_window()
         # sequence parallelism (ring attention): shard the lm time dim over
@@ -181,6 +183,7 @@ class Trainer:
         self._synthetic_data = synthetic_data
         self.bundle = self._build_loaders()
         if self.bundle.num_classes != self.meta.num_classes:
+            # graft: group-uniform -- model + metadata derive from config alone
             self.model, self.meta = zoo.create_model(
                 config.dnn, dataset=config.dataset,
                 num_classes=self.bundle.num_classes,
@@ -220,6 +223,7 @@ class Trainer:
         self._train_step_compiled = False
         self._eval_step_compiled = False
         self._profile_backward_enabled = profile_backward
+        # graft: group-uniform -- the merge schedule solves from broadcast-identical profiles; later swaps ride group-agreed commits
         self.reducer = self._build_reducer(profile_backward)
         if self._sharded_opt or self._cross_step:
             # rs_opt_ag / rs_fwd_ag: the optimizer state lives as 1/world
@@ -267,8 +271,9 @@ class Trainer:
         self._build_steps()
         self._build_run_sinks()
         self.start_epoch = 0
-        self.iteration = 0
+        self.iteration = 0  # graft: group-uniform -- the step counter advances in lockstep; resume/rollback targets are broadcast-agreed
         self.carry = None
+        # graft: group-uniform -- set by autotune(): race winners ride all_argmin, cache hits agree_all
         self.autotune_report = None  # set by autotune() (cache hit or race)
         # resilience layer (ISSUE 5): deterministic fault plan, graceful
         # preemption drain, non-finite-step bookkeeping, mid-epoch resume
@@ -289,6 +294,7 @@ class Trainer:
             reautotune_enabled,
         )
 
+        # graft: group-uniform -- MGWFBP_* detector thresholds parse the one supervisor-exported environment
         self._drift_cfg = DriftConfig.from_env()
         self._drift_detector = (
             DriftDetector(self._drift_cfg) if config.telemetry else None
@@ -300,6 +306,7 @@ class Trainer:
         self._straggler_enabled = (
             config.telemetry and self._drift_cfg.straggler_band > 0
         )
+        # graft: group-uniform -- MGWFBP_DRIFT_REAUTOTUNE is group-uniform env
         self._drift_reautotune_enabled = reautotune_enabled()
         self._drift_reautotune_pending = False
         # training-health telemetry (ISSUE 12): the jitted step packs
@@ -322,7 +329,7 @@ class Trainer:
             if config.telemetry and config.health_stats and health_enabled()
             else None
         )
-        self._pending_health: deque = deque()
+        self._pending_health: deque = deque()  # graft: group-uniform -- fills at the deterministic step cadence; identical length everywhere
         # straggler probe bookkeeping: synchronous SGD equalizes
         # END-TO-END step walls across the group (everyone waits for the
         # straggler inside the collectives — on the CPU mesh even the
@@ -375,7 +382,7 @@ class Trainer:
         # scalar pull costs an RTT, so MGWFBP_GUARD_CHECK_INTERVAL=N
         # batches N steps' flags into ONE stacked pull (detection lags by
         # at most N steps; the in-jit skip protects the params either way)
-        self._pending_guard: deque = deque()
+        self._pending_guard: deque = deque()  # graft: group-uniform -- fills at the deterministic step cadence; identical length everywhere
         self._guard_interval = max(
             int(os.environ.get("MGWFBP_GUARD_CHECK_INTERVAL", "1")), 1
         )
@@ -624,6 +631,7 @@ class Trainer:
         if config.checkpoint_dir:
             # full config tag (dnn/dataset/bs/lr/policy/threshold/seed) so
             # distinct experiments never share a resume directory
+            # graft: group-uniform -- checkpointer presence is config-derived (--checkpoint-dir)
             self.checkpointer = Checkpointer(
                 os.path.join(config.checkpoint_dir, config.tag())
             )
@@ -2052,7 +2060,7 @@ class Trainer:
             ],
             "measured_group_times": measured_groups,
         }
-        if coord.is_primary():
+        if coord.is_primary():  # graft: noqa[RUN004] -- the schedule cache is best-effort persistence: a miss simply re-races, and cache hits require agree_all on every process
             # one writer: the cache file is shared state (and on a shared
             # FS two processes racing the rename could tear it)
             at.save_cache_entry(path, cache_entry)
@@ -2966,10 +2974,12 @@ class Trainer:
                 self.telemetry.now() if self.telemetry is not None else 0.0
             )
             if self.meta.has_carry:
+                # graft: group-uniform -- step outputs are SPMD-replicated; metrics ride the global psum
                 self.state, metrics, self.carry = self.train_step(
                     self.state, batch, self.carry
                 )
             else:
+                # graft: group-uniform -- step outputs are SPMD-replicated; metrics ride the global psum
                 self.state, metrics = self.train_step(self.state, batch)
             self._train_step_compiled = True
             if wd is not None:
@@ -3280,6 +3290,7 @@ class Trainer:
             self._check_guard_value(it, ep, float(v))
 
     def _check_guard_value(self, it: int, epoch: int, flag) -> None:
+        # graft: group-uniform -- the nonfinite count is a globally-psum'd metric
         nonfinite = float(flag)
         if nonfinite <= 0:
             self._bad_streak = 0
@@ -4023,7 +4034,9 @@ class Trainer:
         self.iteration = snap.iteration
         if snap.mid_epoch:
             self.start_epoch = snap.epoch
+            # graft: group-uniform -- the restore step is group-agreed (broadcast / sibling-probe agreement)
             self._resume_epoch = snap.epoch
+            # graft: group-uniform -- the restore step is group-agreed (broadcast / sibling-probe agreement)
             self._resume_skip_steps = snap.epoch_step
             self._resume_carry = snap.carry
         else:
@@ -4319,6 +4332,7 @@ class Trainer:
         snap = None
         if self.checkpointer is not None:
             snap = self._restore_step(self.checkpointer, None)
+        # graft: group-uniform -- checkpoint visibility is uniform on the shared checkpoint FS (the commit barrier publishes the sidecar before any process proceeds)
         if snap is None and self.checkpointer is not None and (
             _elastic_resume_enabled()
         ):
@@ -4536,6 +4550,7 @@ class Trainer:
             except _RollbackRequested as rb:
                 # K consecutive non-finite steps: restore the last
                 # checkpoint and continue from its exact position
+                # graft: group-uniform -- the rollback target is broadcast-agreed from p0
                 epoch = self._rollback(rb)
                 continue
             metrics = {"train": train_metrics}

@@ -1,0 +1,613 @@
+"""SPMD lockstep checker (ISSUE 14): RUN001..RUN006 mutation suite.
+
+Every rule is exercised both ways: a minimal synthetic module seeded with
+the defect must fire EXACTLY the intended rule, and its corrected twin
+must stay clean. Distilled trainer/checkpoint snippets (divergent drain,
+skipped commit barrier, swallowed barrier exception) pin the
+interprocedural machinery — wrappers must carry their callee's group ops.
+The shipped tree itself must check clean (the check.sh stage-2 pin), and
+the @group_op registry must round-trip: a NEW decorated primitive is
+auto-discovered and immediately protected by the rules.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from mgwfbp_tpu.analysis.rules import (
+    FAMILY_BITS,
+    Finding,
+    SuppressionTracker,
+    exit_code,
+)
+from mgwfbp_tpu.analysis.spmd_check import (
+    check_paths,
+    check_sources,
+    discover_group_ops,
+)
+
+IMPORT = "from mgwfbp_tpu.runtime import coordination as coord\n"
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+def _check(src: str, serving: dict | None = None, tracker=None):
+    return check_sources(
+        {"mod.py": IMPORT + src}, serving_sources=serving, tracker=tracker
+    )
+
+
+# --------------------------------------------------------------------------
+# @group_op discovery / registry round-trip
+# --------------------------------------------------------------------------
+
+def test_group_ops_discovered_from_decorations():
+    ops = discover_group_ops()
+    assert {
+        "agree_any", "agree_all", "agree_uniform", "broadcast_flag",
+        "gather_values", "gather_vectors", "all_argmin", "barrier",
+    } <= set(ops)
+    assert ops["barrier"].uniform_result is False
+    assert ops["agree_any"].uniform_result is True
+    assert all(op.blocking for op in ops.values())
+
+
+def test_static_discovery_matches_runtime_registry():
+    # the AST-discovered op list and the imported GROUP_OPS registry are
+    # two views of the SAME decorations — they cannot drift
+    from mgwfbp_tpu.runtime import coordination
+
+    ops = discover_group_ops()
+    assert set(ops) == set(coordination.GROUP_OPS)
+    for name, meta in coordination.GROUP_OPS.items():
+        assert ops[name].blocking == meta["blocking"], name
+        assert ops[name].uniform_result == meta["uniform_result"], name
+
+
+def test_new_primitive_round_trip(tmp_path):
+    # a NEW decorated primitive in the transport is auto-discovered and
+    # immediately covered by the rules — no checker change required
+    transport = tmp_path / "coordination.py"
+    transport.write_text(
+        "GROUP_OPS = {}\n"
+        "def group_op(fn=None, *, blocking=True, uniform_result=True):\n"
+        "    def reg(f):\n"
+        "        GROUP_OPS[f.__name__] = {}\n"
+        "        return f\n"
+        "    return reg(fn) if fn is not None else reg\n"
+        "@group_op\n"
+        "def agree_sum(x):\n"
+        "    return x\n"
+    )
+    ops = discover_group_ops(str(transport))
+    assert "agree_sum" in ops
+    findings = check_sources(
+        {"mod.py": IMPORT + (
+            "def f():\n"
+            "    if coord.is_primary():\n"
+            "        coord.agree_sum(1.0)\n"
+        )},
+        transport_path=str(transport),
+    )
+    assert _ids(findings) == ["RUN001"]
+    assert "agree_sum" in findings[0].message
+
+
+# --------------------------------------------------------------------------
+# RUN001..RUN006, seeded and clean
+# --------------------------------------------------------------------------
+
+def test_run001_op_control_dependent_on_local():
+    findings = _check(
+        "def f():\n"
+        "    if coord.is_primary():\n"
+        "        coord.barrier('x')\n"
+    )
+    assert _ids(findings) == ["RUN001"]
+
+
+def test_run001_process_index_comparison_and_local_var():
+    findings = _check(
+        "def f():\n"
+        "    primary = coord.process_index() == 0\n"
+        "    if primary:\n"
+        "        coord.agree_any(True)\n"
+    )
+    assert _ids(findings) == ["RUN001"]
+
+
+def test_run001_clean_when_local_is_data_not_control():
+    # the canonical sanitize pattern: the local flag is DATA into the
+    # agreement; branching on the agreed result is lockstep-safe
+    findings = _check(
+        "def f(local_flag):\n"
+        "    agreed = coord.agree_any(local_flag)\n"
+        "    if agreed:\n"
+        "        coord.barrier('drain')\n"
+    )
+    assert findings == []
+
+
+def test_run002_arm_sequence_mismatch():
+    findings = _check(
+        "def f(mode):\n"
+        "    if mode:\n"
+        "        coord.agree_any(True)\n"
+        "    else:\n"
+        "        coord.agree_all(True)\n"
+    )
+    assert _ids(findings) == ["RUN002"]
+
+
+def test_run002_clean_when_arms_match():
+    findings = _check(
+        "def f(mode):\n"
+        "    if mode:\n"
+        "        x = 1\n"
+        "        coord.agree_any(True)\n"
+        "    else:\n"
+        "        x = 2\n"
+        "        coord.agree_any(False)\n"
+        "    return x\n"
+    )
+    assert findings == []
+
+
+def test_run003_early_return_skips_barrier():
+    findings = _check(
+        "def f(ready):\n"
+        "    if not ready:\n"
+        "        return None\n"
+        "    coord.barrier('commit')\n"
+    )
+    assert _ids(findings) == ["RUN003"]
+
+
+def test_run003_continue_skips_op_in_loop():
+    findings = _check(
+        "def f(items):\n"
+        "    for it in items:\n"
+        "        if it is None:\n"
+        "            continue\n"
+        "        coord.gather_values(1.0)\n"
+    )
+    assert _ids(findings) == ["RUN003"]
+
+
+def test_run003_clean_when_exit_is_balanced():
+    # both the early path and the fall-through run the same op sequence
+    findings = _check(
+        "def f(ready):\n"
+        "    if not ready:\n"
+        "        coord.barrier('commit')\n"
+        "        return None\n"
+        "    coord.barrier('commit')\n"
+        "    return 1\n"
+    )
+    assert findings == []
+
+
+def test_run003_group_uniform_annotation_clears_and_is_consumed():
+    tracker = SuppressionTracker()
+    findings = _check(
+        "def f(ready):\n"
+        "    if not ready:  # graft: group-uniform -- derived from config\n"
+        "        return None\n"
+        "    coord.barrier('commit')\n",
+        tracker=tracker,
+    )
+    assert findings == []
+    assert tracker.uniform_used  # the marker was consulted -> not ANA001
+    assert tracker.unused_findings() == []
+
+
+def test_run004_primary_write_without_commit_barrier():
+    findings = _check(
+        "import json, os\n"
+        "def f(doc, path):\n"
+        "    if coord.is_primary():\n"
+        "        with open(path, 'w') as fh:\n"
+        "            json.dump(doc, fh)\n"
+    )
+    assert _ids(findings) == ["RUN004"]
+
+
+def test_run004_clean_with_commit_barrier():
+    findings = _check(
+        "import json, os\n"
+        "def f(doc, path):\n"
+        "    if coord.is_primary():\n"
+        "        with open(path, 'w') as fh:\n"
+        "            json.dump(doc, fh)\n"
+        "    coord.barrier('commit')\n"
+    )
+    assert findings == []
+
+
+def test_run004_exonerated_when_every_caller_commits():
+    # the _write_index pattern: the p0-gated helper has no barrier of its
+    # own, but every analyzed call site commits right after
+    findings = _check(
+        "import json\n"
+        "def write_sidecar(doc):\n"
+        "    if not coord.is_primary():\n"
+        "        return\n"
+        "    with open('idx', 'w') as fh:\n"
+        "        json.dump(doc, fh)\n"
+        "def save(doc):\n"
+        "    write_sidecar(doc)\n"
+        "    coord.barrier('commit')\n"
+    )
+    assert findings == []
+
+
+def test_run005_swallowed_group_op_failure():
+    findings = _check(
+        "def f():\n"
+        "    try:\n"
+        "        coord.barrier('sync')\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert _ids(findings) == ["RUN005"]
+
+
+def test_run005_clean_when_handler_reraises():
+    findings = _check(
+        "def f():\n"
+        "    try:\n"
+        "        coord.barrier('sync')\n"
+        "    except Exception as e:\n"
+        "        raise RuntimeError('group broken') from e\n"
+    )
+    assert findings == []
+
+
+def test_run005_clean_when_no_op_in_try():
+    findings = _check(
+        "import json\n"
+        "def f(path):\n"
+        "    try:\n"
+        "        with open(path) as fh:\n"
+        "            return json.load(fh)\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    assert findings == []
+
+
+def test_run006_op_under_serving_lock():
+    serving = {"serve.py": (
+        "class Handler:\n"
+        "    def do_GET(self):\n"
+        "        with self._state_lock:\n"
+        "            x = 1\n"
+    )}
+    findings = _check(
+        "def f(self):\n"
+        "    with self._state_lock:\n"
+        "        coord.barrier('sync')\n",
+        serving=serving,
+    )
+    assert _ids(findings) == ["RUN006"]
+
+
+def test_run006_clean_for_unshared_lock():
+    serving = {"serve.py": (
+        "class Handler:\n"
+        "    def do_GET(self):\n"
+        "        with self._other_lock:\n"
+        "            x = 1\n"
+    )}
+    findings = _check(
+        "def f(self):\n"
+        "    with self._step_lock:\n"
+        "        coord.barrier('sync')\n",
+        serving=serving,
+    )
+    assert findings == []
+
+
+def test_non_uniform_result_op_does_not_sanitize():
+    # barrier is declared @group_op(uniform_result=False): its result
+    # must NOT launder a branch condition into group-uniform
+    findings = _check(
+        "def f():\n"
+        "    x = coord.barrier('a')\n"
+        "    if x:\n"
+        "        coord.agree_all(True)\n"
+    )
+    assert _ids(findings) == ["RUN002"]
+
+
+def test_cli_skip_spmd_does_not_misreport_markers_dead(capsys):
+    # lint-only runs cannot consume RUN noqas / group-uniform markers —
+    # ANA001 must not fire on the clean tree when spmd was skipped
+    from mgwfbp_tpu.analysis.__main__ import main
+
+    rc = main(["--skip-spmd", "--skip-jaxpr"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.out + captured.err
+    assert "ANA001" not in captured.out
+
+
+def test_multihost_short_circuit_is_resolved():
+    # `if process_count() == 1: return` is the sanctioned single-process
+    # short-circuit — never a RUN003
+    findings = _check(
+        "def f():\n"
+        "    if coord.process_count() == 1:\n"
+        "        return True\n"
+        "    return coord.agree_any(True)\n"
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# distilled trainer / checkpoint snippets
+# --------------------------------------------------------------------------
+
+def test_trainer_snippet_divergent_drain():
+    # the bug _agreed_preempt exists to prevent: participation in the
+    # drain depends on the process-LOCAL signal flag
+    findings = _check(
+        "class Trainer:\n"
+        "    def __init__(self):\n"
+        "        self._preempt_signal = None\n"
+        "    def step_loop(self, epoch):\n"
+        "        if self._preempt_signal is not None:\n"
+        "            coord.barrier('drain')\n"
+        "            raise SystemExit(75)\n"
+    )
+    assert _ids(findings) == ["RUN001"]
+
+
+def test_trainer_snippet_agreed_drain_is_clean():
+    findings = _check(
+        "class Trainer:\n"
+        "    def __init__(self):\n"
+        "        self._preempt_signal = None\n"
+        "    def _agreed_preempt(self):\n"
+        "        local = self._preempt_signal is not None\n"
+        "        if coord.process_count() == 1:\n"
+        "            return local\n"
+        "        return coord.agree_any(local)\n"
+        "    def step_loop(self, epoch):\n"
+        "        if self._agreed_preempt():\n"
+        "            coord.barrier('drain')\n"
+        "            raise SystemExit(75)\n"
+    )
+    assert findings == []
+
+
+def test_checkpoint_snippet_skipped_commit_barrier():
+    # the dedup early-return skips the payload barrier peers still enter;
+    # the wrapper _commit_barrier must carry its barrier (interprocedural)
+    findings = _check(
+        "import os\n"
+        "class Ckpt:\n"
+        "    def _commit_barrier(self, step):\n"
+        "        if coord.process_count() > 1:\n"
+        "            coord.barrier('commit')\n"
+        "    def save(self, step, files):\n"
+        "        if os.path.exists(f'steps/{step}'):\n"
+        "            return\n"
+        "        coord.barrier('payload')\n"
+        "        self._commit_barrier(step)\n"
+    )
+    assert _ids(findings) == ["RUN001"]
+
+
+def test_checkpoint_snippet_agreed_dedup_is_clean():
+    # the shipped fix: agree on the dedup decision before branching
+    findings = _check(
+        "import os\n"
+        "class Ckpt:\n"
+        "    def _commit_barrier(self, step):\n"
+        "        if coord.process_count() > 1:\n"
+        "            coord.barrier('commit')\n"
+        "    def save(self, step, files):\n"
+        "        already = os.path.exists(f'steps/{step}')\n"
+        "        if coord.process_count() > 1:\n"
+        "            already = coord.agree_all(already)\n"
+        "        if already:\n"
+        "            self._commit_barrier(step)\n"
+        "            return\n"
+        "        coord.barrier('payload')\n"
+        "        self._commit_barrier(step)\n"
+    )
+    assert findings == []
+
+
+def test_checkpoint_snippet_swallowed_commit_barrier():
+    findings = _check(
+        "class Ckpt:\n"
+        "    def save(self, step):\n"
+        "        try:\n"
+        "            coord.barrier(f'ckpt_commit_{step}')\n"
+        "        except RuntimeError:\n"
+        "            self.log = 'commit barrier failed; continuing'\n"
+    )
+    assert _ids(findings) == ["RUN005"]
+
+
+# --------------------------------------------------------------------------
+# the shipped tree: zero unsuppressed findings, fast, accounted
+# --------------------------------------------------------------------------
+
+def test_shipped_tree_is_clean_and_fast():
+    tracker = SuppressionTracker()
+    t0 = time.perf_counter()
+    findings = check_paths(tracker=tracker)
+    dt = time.perf_counter() - t0
+    assert findings == [], [f.format() for f in findings]
+    assert dt < 30.0, f"RUN pass took {dt:.1f}s (acceptance bound: 30s)"
+    # every suppression and group-uniform annotation in the tree is live
+    assert tracker.unused_findings() == [], [
+        f.format() for f in tracker.unused_findings()
+    ]
+    # ... and the surviving suppressions actually hide real findings
+    assert tracker.suppressed_findings, (
+        "expected the documented deliberate suppressions to be exercised"
+    )
+
+
+# --------------------------------------------------------------------------
+# ANA001: dead / reason-less suppressions
+# --------------------------------------------------------------------------
+
+def test_ana001_dead_noqa_reported():
+    tracker = SuppressionTracker()
+    findings = _check(
+        "def f():\n"
+        "    x = 1  # graft: noqa[RUN003] -- stale\n"
+        "    return x\n",
+        tracker=tracker,
+    )
+    assert findings == []
+    dead = tracker.unused_findings()
+    assert _ids(dead) == ["ANA001"]
+    assert "RUN003" in dead[0].message
+
+
+def test_ana001_partially_dead_noqa_names_the_dead_id():
+    tracker = SuppressionTracker()
+    findings = _check(
+        "def f(ready):\n"
+        "    if not ready:\n"
+        "        return None  # graft: noqa[RUN003,RUN006] -- only 003 fires\n"
+        "    coord.barrier('commit')\n",
+        tracker=tracker,
+    )
+    assert findings == []  # RUN003 suppressed
+    dead = tracker.unused_findings()
+    assert len(dead) == 1 and "RUN006" in dead[0].message
+    assert "RUN003" not in dead[0].message
+
+
+def test_ana001_reasonless_run_suppression_reported():
+    tracker = SuppressionTracker()
+    findings = _check(
+        "def f(ready):\n"
+        "    if not ready:\n"
+        "        return None  # graft: noqa[RUN003]\n"
+        "    coord.barrier('commit')\n",
+        tracker=tracker,
+    )
+    assert findings == []
+    dead = tracker.unused_findings()
+    assert len(dead) == 1 and "without a reason" in dead[0].message
+
+
+def test_ana001_unconsumed_group_uniform_reported():
+    tracker = SuppressionTracker()
+    findings = _check(
+        "def f():\n"
+        "    x = 1  # graft: group-uniform -- nothing consults this\n"
+        "    return x\n",
+        tracker=tracker,
+    )
+    assert findings == []
+    dead = tracker.unused_findings()
+    assert _ids(dead) == ["ANA001"]
+    assert "never consulted" in dead[0].message
+
+
+def test_ana001_docstring_grammar_mentions_do_not_register():
+    tracker = SuppressionTracker()
+    findings = _check(
+        'def f():\n'
+        '    """Docs quoting `# graft: noqa[RUN003]` and\n'
+        '    `# graft: group-uniform -- reason` are not markers."""\n'
+        '    return 1\n',
+        tracker=tracker,
+    )
+    assert findings == []
+    assert tracker.unused_findings() == []
+
+
+# --------------------------------------------------------------------------
+# exit codes + --json CLI
+# --------------------------------------------------------------------------
+
+def test_family_exit_codes_compose():
+    fs = [
+        Finding("a.py", 1, "JIT001", "m"),
+        Finding("a.py", 2, "RUN003", "m"),
+    ]
+    assert exit_code(fs) == FAMILY_BITS["JIT"] | FAMILY_BITS["RUN"] == 5
+    assert exit_code([Finding("a.py", 1, "SCH004", "m")]) == 2
+    assert exit_code([Finding("a.py", 1, "ANA001", "m")]) == 8
+    assert exit_code([Finding("<jaxpr>", 0, "TRC000", "m")]) == 16
+    # JIT004 is a warning: counted only under warnings_as_errors
+    assert exit_code([Finding("a.py", 1, "JIT004", "m")]) == 0
+    assert exit_code(
+        [Finding("a.py", 1, "JIT004", "m")], warnings_as_errors=True
+    ) == 1
+
+
+def test_cli_json_output_and_jit_exit_bit(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time, jax\nfrom functools import partial\n"
+        "@partial(jax.jit)\ndef f(x):\n    return x + time.time()\n"
+    )
+    from mgwfbp_tpu.analysis.__main__ import main
+
+    rc = main(["--skip-jaxpr", "--skip-spmd", "--json", str(bad)])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == FAMILY_BITS["JIT"] == 1
+    assert doc["exit_code"] == 1
+    assert doc["errors_by_family"] == {"JIT": 1}
+    rows = [f for f in doc["findings"] if f["rule"] == "JIT001"]
+    assert rows and rows[0]["file"] == str(bad)
+    assert rows[0]["severity"] == "error"
+    assert rows[0]["suppressed"] is False
+    assert rows[0]["line"] == 5
+
+
+def test_cli_json_marks_suppressed_findings(tmp_path, capsys):
+    bad = tmp_path / "sup.py"
+    bad.write_text(
+        "import time, jax\nfrom functools import partial\n"
+        "@partial(jax.jit)\ndef f(x):\n"
+        "    return x + time.time()  # graft: noqa[JIT001] -- pinned wall\n"
+    )
+    from mgwfbp_tpu.analysis.__main__ import main
+
+    rc = main(["--skip-jaxpr", "--skip-spmd", "--json", str(bad)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    sup = [f for f in doc["findings"] if f["suppressed"]]
+    assert [f["rule"] for f in sup] == ["JIT001"]
+
+
+def test_cli_spmd_and_ana_run_by_default(capsys):
+    # the shipped tree is pinned clean through the CLI path too
+    from mgwfbp_tpu.analysis.__main__ import main
+
+    rc = main(["--skip-jaxpr"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.out + captured.err
+    assert "0 error(s)" in captured.err
+
+
+@pytest.mark.slow
+def test_cli_trace_failure_exit_bit_is_distinct(capsys):
+    # a model that cannot build is TRC000 / bit 16 — CI can tell
+    # "failed to trace" from "protocol violated" by exit code alone
+    from mgwfbp_tpu.analysis.__main__ import main
+
+    rc = main([
+        "--skip-lint", "--skip-spmd", "--model", "no_such_model",
+        "--policies", "wfbp", "--comm-ops", "all_reduce",
+    ])
+    captured = capsys.readouterr()
+    assert rc == FAMILY_BITS["TRC"] == 16, captured.out + captured.err
+    assert "TRC000" in captured.out

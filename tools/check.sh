@@ -14,7 +14,12 @@ else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/7] mgwfbp_tpu.analysis (schedule verifier + jit-safety lint) =="
+echo "== [2/7] mgwfbp_tpu.analysis (jit-safety lint -> SPMD lockstep checker -> schedule verifier) =="
+# cheapest-first inside the CLI: the RUN-family SPMD pass statically
+# proves the multi-host protocol balanced in ~1 s, so a coordination bug
+# fails HERE in seconds instead of hanging the multi-minute live smokes
+# below into their hard timeouts; the zero-finding state of the shipped
+# tree is pinned by this stage (ANA001 keeps the suppressions honest)
 JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
 
 echo "== [3/7] telemetry report smoke (writer -> report -> exports) =="
