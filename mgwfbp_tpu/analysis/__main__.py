@@ -6,11 +6,14 @@
     python -m mgwfbp_tpu.analysis path/to/file.py # lint specific targets
 
 Pass order is cheapest-first so protocol bugs fail in seconds: the AST
-jit-safety lint, then the SPMD lockstep checker (RUN001..RUN006 over the
-multi-host protocol surfaces — runtime/, train/trainer.py,
-checkpoint.py, parallel/autotune.py, telemetry/drift.py), then ANA001
-(dead-suppression accounting over everything the first two passes saw),
-then the jaxpr pass, which traces the jitted MG-WFBP train step on an
+jit-safety lint, then the host-concurrency race checker (THR001..THR005
+over the thread/handler/observer/signal surfaces — runtime/,
+train/trainer.py, checkpoint.py, telemetry/{serve,fleet,events,
+recorder}.py, utils/watchdog.py, data/loader.py), then the SPMD
+lockstep checker (RUN001..RUN006 over the multi-host protocol surfaces
+— runtime/, train/trainer.py, checkpoint.py, parallel/autotune.py,
+telemetry/drift.py), then ANA001 (dead-suppression accounting over
+everything the earlier passes saw), then the jaxpr pass, which traces the jitted MG-WFBP train step on an
 8-device virtual CPU mesh — pure tracing, no computation, no
 accelerator needed — once per merge policy, so the schedule-realization
 invariants are checked across the whole policy surface (wfbp / single /
@@ -20,7 +23,7 @@ Exit codes are stable per rule family (CI can tell WHICH gate failed):
 bit 1 = JIT lint errors, bit 2 = SCH schedule-verifier errors, bit 4 =
 RUN lockstep errors, bit 8 = ANA annotation errors, bit 16 = the jaxpr
 pass failed to TRACE (TRC000 — a model/build failure, not a protocol
-violation). 0 = clean.
+violation), bit 32 = THR host-concurrency race errors. 0 = clean.
 
 ``--json`` prints one JSON document on stdout: every finding (including
 suppressed ones, marked) with rule id, severity, file, line, message,
@@ -50,6 +53,9 @@ def main(argv=None) -> int:
                         help="skip the AST lint pass")
     parser.add_argument("--skip-spmd", action="store_true",
                         help="skip the SPMD lockstep pass (RUN rules)")
+    parser.add_argument("--skip-thr", action="store_true",
+                        help="skip the host-concurrency race pass "
+                        "(THR rules)")
     parser.add_argument("--skip-jaxpr", action="store_true",
                         help="skip the jaxpr schedule-verification pass")
     parser.add_argument("--json", action="store_true", dest="as_json",
@@ -97,15 +103,27 @@ def main(argv=None) -> int:
         ))]
         findings.extend(lint_paths(targets, tracker))
 
+    if not args.skip_thr:
+        from mgwfbp_tpu.analysis.race_check import (
+            check_paths as thr_check_paths,
+        )
+
+        # explicit paths narrow the race pass too (like the lint), so a
+        # seeded single-file probe exercises THR alone in milliseconds
+        findings.extend(thr_check_paths(
+            paths=args.paths or None, tracker=tracker,
+        ))
+
     if not args.skip_spmd:
         from mgwfbp_tpu.analysis.spmd_check import check_paths
 
         findings.extend(check_paths(tracker=tracker))
 
-    # ANA001 runs only when BOTH consuming passes ran: lint consumes JIT
-    # noqas, spmd consumes RUN noqas + group-uniform markers — skipping
-    # either would misreport that pass's live markers as dead
-    if not args.skip_lint and not args.skip_spmd:
+    # ANA001 runs only when EVERY consuming pass ran: lint consumes JIT
+    # noqas, the race pass THR noqas + thread-safe pins, spmd RUN noqas
+    # + group-uniform markers — skipping any would misreport that pass's
+    # live markers as dead
+    if not args.skip_lint and not args.skip_spmd and not args.skip_thr:
         findings.extend(tracker.unused_findings())
 
     if not args.skip_jaxpr:

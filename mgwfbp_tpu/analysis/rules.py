@@ -1,6 +1,6 @@
 """Rule registry, findings, and suppression for the static-analysis suite.
 
-Four rule families share this framework:
+Five rule families share this framework:
   * JIT0xx — AST lint rules for tracing-unsafe Python inside jitted/scanned
     code (`analysis.ast_lint`);
   * SCH0xx — jaxpr-level merge-schedule invariants checked against the
@@ -8,6 +8,9 @@ Four rule families share this framework:
   * RUN0xx — SPMD lockstep rules for the host-side multi-host coordination
     protocol (`analysis.spmd_check`): every process must execute the
     identical group-operation sequence, statically;
+  * THR0xx — host-concurrency race rules (`analysis.race_check`): shared
+    state and lock discipline across the discovered thread / executor /
+    HTTP-handler / observer / signal contexts;
   * ANA0xx — meta rules about the analysis annotations themselves
     (a suppression that suppresses nothing, a suppression without a
     reason).
@@ -141,12 +144,34 @@ _register("RUN006", ERROR,
           "blocking group operation reachable while holding a lock the "
           "serving plane also takes (HTTP handler <-> step-loop deadlock)")
 
+# --- host-concurrency race rules (analysis.race_check) ----------------------
+_register("THR001", ERROR,
+          "shared attribute written from two or more concurrency contexts "
+          "with no common lock held across the writes (torn/lost update)")
+_register("THR002", ERROR,
+          "lock-order inversion: two locks acquired in opposite orders by "
+          "concurrent contexts (classic ABBA deadlock)")
+_register("THR003", ERROR,
+          "blocking operation (group op / file I/O / sleep / HTTP) while "
+          "holding a lock a serving-plane handler also takes — one slow "
+          "or wedged call freezes the observability plane (generalizes "
+          "RUN006 beyond group ops)")
+_register("THR004", ERROR,
+          "signal handler doing non-async-signal-safe work (lock "
+          "acquisition, blocking I/O, group op) — the handler can run "
+          "while the interrupted thread holds the very lock it wants")
+_register("THR005", ERROR,
+          "stream written without the lock its close() holds — a "
+          "daemon-thread write can race close() and land on a closed "
+          "file (or be torn mid-record)")
+
 # --- annotation meta rules --------------------------------------------------
 _register("ANA001", ERROR,
           "dead or reason-less suppression: a '# graft: noqa[...]' that "
           "suppresses nothing, a '# graft: group-uniform' the checker "
-          "never consulted, or a RUN-family suppression without a "
-          "'-- reason' string")
+          "never consulted, a '# graft: thread-safe' the race checker "
+          "never consulted, or a RUN-family / value-annotation "
+          "suppression without a '-- reason' string")
 
 # --- trace failures (not a protocol violation) ------------------------------
 _register("TRC000", ERROR,
@@ -156,7 +181,7 @@ _register("TRC000", ERROR,
 
 # exit-code bits, one per family: CI distinguishes WHICH gate failed from
 # the exit code alone (documented in README "Static analysis")
-FAMILY_BITS = {"JIT": 1, "SCH": 2, "RUN": 4, "ANA": 8, "TRC": 16}
+FAMILY_BITS = {"JIT": 1, "SCH": 2, "RUN": 4, "ANA": 8, "TRC": 16, "THR": 32}
 
 
 def family(rule_id: str) -> str:
@@ -181,8 +206,14 @@ _NOQA = re.compile(r"#\s*graft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s]+)\])?")
 # string after ' -- ' is required for RUN-family noqa and group-uniform
 # markers (ANA001 enforces it).
 _GROUP_UNIFORM = re.compile(r"#\s*graft:\s*group-uniform\b")
+# value annotation for the race checker: the shared state / blocking
+# call on (or under the `def` carrying) this line is DELIBERATELY
+# lock-free and the author accepts the interleavings — e.g. the
+# watchdog's torn-read-tolerant heartbeat. Always requires a reason.
+_THREAD_SAFE = re.compile(r"#\s*graft:\s*thread-safe\b")
 _REASON = re.compile(
-    r"#\s*graft:\s*(?:noqa(?:\[[^\]]*\])?|group-uniform)\s*--\s*\S"
+    r"#\s*graft:\s*(?:noqa(?:\[[^\]]*\])?|group-uniform|thread-safe)"
+    r"\s*--\s*\S"
 )
 
 
@@ -206,6 +237,12 @@ def has_group_uniform_marker(source_line: str) -> bool:
     annotation (spmd_check treats the condition/assigned value on that
     line as group-uniform)."""
     return _GROUP_UNIFORM.search(source_line) is not None
+
+
+def has_thread_safe_marker(source_line: str) -> bool:
+    """True when the line carries a ``# graft: thread-safe`` annotation
+    (race_check accepts the lock-free access/blocking call it marks)."""
+    return _THREAD_SAFE.search(source_line) is not None
 
 
 def has_reason(source_line: str) -> bool:
@@ -249,13 +286,23 @@ class SuppressionTracker:
         self.markers: dict[tuple[str, int], frozenset[str]] = {}
         # (file, line) of group-uniform value annotations
         self.uniform_markers: set[tuple[str, int]] = set()
+        # (file, line) of thread-safe value annotations (race_check)
+        self.threadsafe_markers: set[tuple[str, int]] = set()
         # (file, line) lines whose marker carries a reason string
         self._reasoned: set[tuple[str, int]] = set()
         # consumed: (file, line, rule_id) for noqa, (file, line) for uniform
         self.used: set[tuple[str, int, str]] = set()
         self.uniform_used: set[tuple[str, int]] = set()
+        self.threadsafe_used: set[tuple[str, int]] = set()
         self.suppressed_findings: list[Finding] = []
         self._scanned: set[str] = set()
+        # grammar -> files its consuming pass actually analyzed this run.
+        # A value annotation is only provably DEAD when the pass that
+        # could consume it ran over the file it sits in — an SPMD-only
+        # run must not call the race checker's thread-safe pins dead
+        # (and vice versa), and a paths-restricted run must not condemn
+        # pins in files it never analyzed.
+        self._value_pass_files: dict[str, set[str]] = {}
 
     def scan_source(self, path: str, source: str) -> None:
         if path in self._scanned:
@@ -270,6 +317,8 @@ class SuppressionTracker:
                 self.markers[(path, i)] = ids
             if has_group_uniform_marker(line):
                 self.uniform_markers.add((path, i))
+            if has_thread_safe_marker(line):
+                self.threadsafe_markers.add((path, i))
             if has_reason(line):
                 self._reasoned.add((path, i))
 
@@ -289,6 +338,13 @@ class SuppressionTracker:
 
     def note_uniform_used(self, path: str, line: int) -> None:
         self.uniform_used.add((path, line))
+
+    def note_threadsafe_used(self, path: str, line: int) -> None:
+        self.threadsafe_used.add((path, line))
+
+    def note_value_pass(self, grammar: str, paths: Iterable[str]) -> None:
+        """Record that `grammar`'s consuming pass analyzed `paths`."""
+        self._value_pass_files.setdefault(grammar, set()).update(paths)
 
     def unused_findings(self) -> list[Finding]:
         """ANA001 findings: dead noqa ids, dead group-uniform markers, and
@@ -323,7 +379,10 @@ class SuppressionTracker:
                         "bare noqa suppresses nothing on this line — "
                         "remove the dead suppression",
                     ))
+        uniform_scope = self._value_pass_files.get("group-uniform", set())
         for (path, line) in sorted(self.uniform_markers):
+            if path not in uniform_scope:
+                continue
             if (path, line) not in self.uniform_used:
                 out.append(Finding(
                     path, line, "ANA001",
@@ -336,6 +395,23 @@ class SuppressionTracker:
                     path, line, "ANA001",
                     "group-uniform annotation without a reason — append "
                     "'-- <why this value is identical on every process>'",
+                ))
+        threadsafe_scope = self._value_pass_files.get("thread-safe", set())
+        for (path, line) in sorted(self.threadsafe_markers):
+            if path not in threadsafe_scope:
+                continue
+            if (path, line) not in self.threadsafe_used:
+                out.append(Finding(
+                    path, line, "ANA001",
+                    "thread-safe annotation the race checker never "
+                    "consulted — remove it or move it to the access / "
+                    "blocking call (or its enclosing def) it describes",
+                ))
+            elif (path, line) not in self._reasoned:
+                out.append(Finding(
+                    path, line, "ANA001",
+                    "thread-safe annotation without a reason — append "
+                    "'-- <why the lock-free interleaving is acceptable>'",
                 ))
         return out
 

@@ -1171,6 +1171,10 @@ class Checker:
         by_mod: dict[str, list[Finding]] = {}
         for f in self.findings:
             by_mod.setdefault(f.file, []).append(f)
+        if self.tracker is not None:
+            self.tracker.note_value_pass(
+                "group-uniform", (m.path for m in self.modules),
+            )
         for mod in self.modules:
             fs = by_mod.get(mod.path, [])
             if self.tracker is not None:

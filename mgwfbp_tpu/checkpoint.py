@@ -53,6 +53,7 @@ import dataclasses
 import json
 import os
 import shutil
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -545,6 +546,35 @@ class ShardSource:
         return []
 
 
+class _AsyncShardSave:
+    """One in-flight asynchronous shard-native save (single slot).
+
+    Ownership protocol (what makes this race-free, and what the THR
+    checker's THR001 is calibrated against): the submitting (step-loop)
+    thread fills every field, hands the slot to the writer thread, and
+    touches nothing but `done` until `done.is_set()` — the writer thread
+    owns `error` exclusively until then, and `done.set()` is the
+    publication edge (threading.Event carries the memory ordering). All
+    group operations — step agreement, dedup vote, payload barrier,
+    manifest commit — happen on the submitting thread (submit_sharded /
+    poll_async); the writer thread performs ONLY local filesystem I/O,
+    so the SPMD lockstep contract (collectives issued from one thread in
+    one program order) is untouched.
+    """
+
+    def __init__(self, step: int, manifest: dict, entry: dict,
+                 nbytes: int):
+        self.step = step
+        self.manifest = manifest
+        self.entry = entry
+        self.nbytes = nbytes
+        self.t0 = time.perf_counter()
+        self.final: Optional[str] = None  # payload dir, set by the writer
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+
+
 class Checkpointer:
     """Step-indexed checkpoint manager over one run directory."""
 
@@ -565,6 +595,9 @@ class Checkpointer:
             item_handlers=ocp.StandardCheckpointHandler(),
         )
         self._index = self._load_index()
+        # single-slot async shard-native save (ISSUE 16): at most one
+        # in-flight background payload write; a new save drains it first
+        self._async: Optional[_AsyncShardSave] = None
 
     # -- sidecar index ----------------------------------------------------
     def _index_path(self) -> str:
@@ -675,7 +708,29 @@ class Checkpointer:
         a fresh --ckpt-every-steps snapshot). Returns
         {"duration_s", "bytes"} for the telemetry `checkpoint` event.
         """
+        # single writer slot: an in-flight async save commits before a
+        # new snapshot of the same state family starts (collective —
+        # every process drains here before its step agreement below)
+        self.drain_async(durable=wait)
         t0 = time.perf_counter()
+        step, entry, nbytes, already = self._sharded_head(manifest, files)
+        # graft: group-uniform -- 'already' is the agree_all dedup vote from _sharded_head: every process holds the same value
+        if already:
+            self._promote_sharded(step, manifest, entry)
+            return {
+                "duration_s": time.perf_counter() - t0, "bytes": 0,
+            }
+        self._write_shard_payload(step, files, wait=wait)
+        self._commit_sharded(step, manifest, entry, wait=wait)
+        return {"duration_s": time.perf_counter() - t0, "bytes": nbytes}
+
+    def _sharded_head(
+        self, manifest: dict, files: dict[str, np.ndarray]
+    ) -> tuple[int, dict, int, bool]:
+        """Group-agreed preamble of every shard-native save: the step-key
+        uniformity check, the sidecar entry, the payload size, and the
+        collective dedup decision. Runs on the submitting thread for the
+        async path too — the writer thread never issues a collective."""
         step = int(manifest["step"])
         if coord.process_count() > 1 and not coord.agree_uniform(
             float(step)
@@ -703,23 +758,35 @@ class Checkpointer:
             # process sees the step committed; otherwise all re-save —
             # the payload write is idempotent (tmp + os.replace)
             already = coord.agree_all(already)
-        if already:
-            prev = self._index.get(str(step), {})
-            if prev:
-                # same dedup/promotion contract as the orbax path: the
-                # payload at this step is immutable, only the entry's
-                # epoch/boundary class may move (and never backwards)
-                entry = dict(prev)
-                entry["epoch"] = int(meta.get("epoch", entry.get("epoch", 0)))
-                if not meta.get("mid_epoch", False):
-                    entry["mid_epoch"] = False
-            self._index[str(step)] = entry
-            self._gc()
-            self._write_index()
-            self._commit_barrier(step)
-            return {
-                "duration_s": time.perf_counter() - t0, "bytes": 0,
-            }
+        return step, entry, nbytes, already
+
+    def _promote_sharded(
+        self, step: int, manifest: dict, entry: dict
+    ) -> None:
+        """Index-entry promotion for an already-committed step (an epoch
+        boundary landing on a fresh --ckpt-every-steps snapshot)."""
+        meta = manifest.get("meta") or {}
+        prev = self._index.get(str(step), {})
+        if prev:
+            # same dedup/promotion contract as the orbax path: the
+            # payload at this step is immutable, only the entry's
+            # epoch/boundary class may move (and never backwards)
+            entry = dict(prev)
+            entry["epoch"] = int(meta.get("epoch", entry.get("epoch", 0)))
+            if not meta.get("mid_epoch", False):
+                entry["mid_epoch"] = False
+        self._index[str(step)] = entry
+        self._gc()
+        self._write_index()
+        self._commit_barrier(step)
+
+    def _write_shard_payload(
+        self, step: int, files: dict[str, np.ndarray], wait: bool
+    ) -> str:
+        """THIS process's payload subtree: tmp dir + np.save + os.replace.
+        Purely local filesystem work — no group ops, no Checkpointer
+        state writes — which is exactly what licenses running it on the
+        async writer thread. Returns the committed subtree path."""
         step_dir = self._shard_step_dir(step)
         pid = coord.process_index()
         os.makedirs(step_dir, exist_ok=True)
@@ -737,6 +804,14 @@ class Checkpointer:
             # right after the rc-75 exit, so flush this process's files
             # (and the dir entry) before the commit barriers release
             _fsync_dir_files(final)
+        return final
+
+    def _commit_sharded(
+        self, step: int, manifest: dict, entry: dict, wait: bool
+    ) -> None:
+        """Commit a written payload: payload barrier, p0 manifest +
+        sidecar, group success vote, commit barrier. Collective — always
+        runs on the submitting thread, never the async writer."""
         # every process's subtree must be durable before the manifest
         # (the commit record) appears
         if coord.process_count() > 1:
@@ -748,6 +823,7 @@ class Checkpointer:
         # latent multi-host hang the SPMD checker's RUN003 formalizes).
         # A local failure therefore becomes a GROUP decision: everyone
         # agrees on commit success and everyone raises together.
+        step_dir = self._shard_step_dir(step)
         commit_err: Optional[str] = None
         try:
             if coord.is_primary():
@@ -789,7 +865,148 @@ class Checkpointer:
                 "the previous checkpoint"
             )
         self._commit_barrier(step)
-        return {"duration_s": time.perf_counter() - t0, "bytes": nbytes}
+
+    # -- async shard-native save (ISSUE 16) -------------------------------
+    def submit_sharded(
+        self, manifest: dict, files: dict[str, np.ndarray]
+    ) -> Optional[dict]:
+        """Start a shard-native save WITHOUT blocking the step loop on
+        the payload write. The group-agreed preamble (step uniformity +
+        dedup vote) still runs here, synchronously — it is collective —
+        but the np.save of this process's subtree moves to a background
+        thread; the commit (also collective) happens later, on the
+        calling thread, via poll_async()/drain_async().
+
+        Ownership contract: the caller hands `files` over — the arrays
+        must not be mutated after submission (the trainer's payload
+        builder materializes fresh host copies per call, so the step
+        loop updating device state cannot touch them).
+
+        Returns None when the save is now in flight, or the sync-path
+        stats dict when the step was already committed (dedup promotes
+        the index entry immediately — there is no payload to write).
+        """
+        self.drain_async()  # single slot: retire any previous save first
+        t0 = time.perf_counter()
+        step, entry, nbytes, already = self._sharded_head(manifest, files)
+        # graft: group-uniform -- 'already' is the agree_all dedup vote from _sharded_head: every process holds the same value
+        if already:
+            self._promote_sharded(step, manifest, entry)
+            return {
+                "duration_s": time.perf_counter() - t0, "bytes": 0,
+            }
+        slot = _AsyncShardSave(step, manifest, entry, nbytes)
+        slot.thread = threading.Thread(
+            target=self._shard_payload_worker, args=(slot, files),
+            name=f"ckpt-shard-writer-{step}", daemon=True,
+        )
+        self._async = slot
+        slot.thread.start()
+        return None
+
+    def _shard_payload_worker(
+        self, slot: _AsyncShardSave, files: dict[str, np.ndarray]
+    ) -> None:
+        """Async writer thread body: local payload I/O only (see the
+        _AsyncShardSave ownership protocol). Group ops are off-limits
+        here — the commit waits for poll_async on the loop thread."""
+        try:
+            slot.final = self._write_shard_payload(
+                slot.step, files, wait=False
+            )
+        except Exception as e:  # noqa: BLE001 — the error crosses the
+            # thread boundary through the slot; poll_async re-raises it
+            # on the loop thread as a group-agreed commit failure
+            slot.error = f"{type(e).__name__}: {e}"
+        finally:
+            slot.done.set()
+
+    def poll_async(
+        self, block: bool = False, durable: bool = False
+    ) -> Optional[dict]:
+        """Retire the in-flight async save if (on multi-host: the whole
+        group's) payload write has finished; otherwise return None.
+
+        COLLECTIVE on multi-host — every process must call it at the
+        same point in its program (the trainer polls at the same
+        agree-interval cadence that gates preemption agreement), because
+        the completion check is a group vote: committing when only THIS
+        process's payload landed would publish a manifest over peers'
+        unwritten subtrees. With block=True, waits for the local writer
+        first (the drain paths). durable=True upgrades the commit to the
+        fsync'd rc-75 contract, flushing the payload post-hoc.
+
+        Returns the telemetry fields for the `checkpoint` event
+        ({"step", "duration_s", "bytes", "async", "meta"}) once the save
+        commits; raises if any process's payload write failed (all
+        processes raise together — the agree_all vote below).
+        """
+        slot = self._async
+        if slot is None:
+            return None
+        if block:
+            slot.done.wait()
+        done = slot.done.is_set()
+        if coord.process_count() > 1:
+            done = coord.agree_all(done)
+        if not done:
+            return None
+        self._async = None
+        if slot.thread is not None:
+            slot.thread.join()
+        ok = slot.error is None
+        if coord.process_count() > 1:
+            ok = coord.agree_all(ok)
+        if not ok:
+            raise RuntimeError(
+                f"async shard payload write for step {slot.step} failed "
+                f"({slot.error or 'on a peer process'}); no process "
+                "committed the step — restore falls back to the previous "
+                "checkpoint"
+            )
+        if durable and slot.final is not None:
+            # the payload was written lazily (page cache); the rc-75
+            # drain wants it durable before the commit record appears
+            _fsync_dir_files(slot.final)
+        self._commit_sharded(
+            slot.step, slot.manifest, slot.entry, wait=durable
+        )
+        return {
+            "step": slot.step,
+            "duration_s": time.perf_counter() - slot.t0,
+            "bytes": slot.nbytes,
+            "async": True,
+            "meta": dict(slot.manifest.get("meta") or {}),
+        }
+
+    def drain_async(self, durable: bool = False) -> Optional[dict]:
+        """Block until any in-flight async save has committed (collective
+        on multi-host, like poll_async). No-op when the slot is empty."""
+        return self.poll_async(block=True, durable=durable)
+
+    def abandon_async(self) -> Optional[int]:
+        """Drop the in-flight async save WITHOUT committing (the rollback
+        path: the snapshot comes from the suspect regime, and its step
+        key may be re-reached after the replay). Purely local — no
+        collectives, so it is safe at any group state as long as every
+        process takes the same decision (rollback is broadcast-agreed).
+        The manifest never appears, so restore ignores the payload and a
+        later save of the same step overwrites it. Returns the abandoned
+        step, or None when the slot was empty."""
+        slot = self._async
+        if slot is None:
+            return None
+        self._async = None
+        if slot.thread is not None:
+            # wait out the local writer: a replayed save can re-reach
+            # this step key and must not race the old worker's tmp dir
+            slot.thread.join()
+        return slot.step
+
+    def pending_async_step(self) -> Optional[int]:
+        """Step key of the in-flight async save, or None."""
+        slot = self._async
+        return None if slot is None else slot.step
 
     # -- save -------------------------------------------------------------
     def save(self, snap: Snapshot, wait: bool = False) -> None:
@@ -1347,9 +1564,41 @@ class Checkpointer:
         return out
 
     def wait(self) -> None:
+        """Durability point: both async machineries (orbax's background
+        commit and the shard-native writer slot) are drained. Collective
+        on multi-host when a shard save is pending — call it from the
+        same program point on every process (the trainer's callers do)."""
+        self.drain_async()
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
+        slot = self._async
+        if slot is not None:
+            if coord.process_count() == 1:
+                # single process: the drain is pure local work + commit;
+                # finishing it is strictly better than dropping the save
+                try:
+                    self.drain_async()
+                except RuntimeError:
+                    pass  # a failed payload write must not block close
+            else:
+                # multi-host close is the DISORDERLY path (orderly exits
+                # drain at a boundary save / wait() first): peers may
+                # already be gone, so the collective commit could hang on
+                # a dead process. Abandon the uncommitted save — the
+                # manifest never appeared, so restore ignores the torn
+                # subtrees and falls back to the last committed step.
+                self._async = None
+                import warnings
+
+                warnings.warn(
+                    f"close() with async shard save of step {slot.step} "
+                    "still in flight on a multi-host run: abandoning the "
+                    "uncommitted save (restore uses the previous "
+                    "committed step)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         self._mgr.close()
 
 

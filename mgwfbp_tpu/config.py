@@ -109,6 +109,13 @@ class TrainConfig:
     # gathered interchange form, for interchange with pre-ISSUE-13
     # consumers. Both formats RESTORE transparently regardless of this
     # setting (it selects the save side only).
+    ckpt_async: bool = True  # background shard-native payload writer
+    # (ISSUE 16): mid-epoch --ckpt-every-steps saves snapshot the shard
+    # rows at the step boundary and hand the np.save to a writer thread;
+    # the commit (group barriers + manifest) lands on the step-loop
+    # thread at the preemption agree-interval cadence. False = every
+    # save blocks the step loop (pre-ISSUE-16 behavior). Epoch-boundary
+    # and drain (wait=True) saves are always synchronous.
     # resilience layer (ISSUE 5)
     ckpt_every_steps: int = 0  # mid-epoch step-indexed checkpoints every N
     # optimizer steps (0 = epoch boundaries only); a SIGTERM/SIGINT drain

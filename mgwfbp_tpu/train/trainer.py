@@ -3014,6 +3014,15 @@ class Trainer:
                 self.save_step(epoch, epoch_pos)
                 if wd is not None:
                     wd.beat(wd_phase)
+            # retire a completed async shard save. Multi-host this is a
+            # collective vote, so it runs on the SAME deterministic
+            # cadence as preemption agreement (every _agree_interval-th
+            # step, every process) — never gated on the local slot state
+            if self.checkpointer is not None and (
+                coord.process_count() == 1
+                or self.iteration % self._agree_interval == 0
+            ):
+                self._poll_async_ckpt()
             sig = self._faults.preempt_signal_after(self.iteration)
             if sig is not None:
                 self._deliver_preempt(sig)
@@ -3149,6 +3158,9 @@ class Trainer:
                 _signal.signal(s, h)
             except ValueError:
                 pass
+        # graft: thread-safe -- GIL-atomic bool store; the signal context
+        # only ever flips it False, so the worst interleaving with the
+        # main-thread arm/disarm pair is one redundant disarm
         self._signals_armed = False
 
     def _on_preempt_signal(self, signum, frame) -> None:
@@ -3166,6 +3178,10 @@ class Trainer:
                 f"second {name} during preemption drain — escalating "
                 "(next signal kills outright)"
             )
+        # graft: thread-safe -- one-word flag store is GIL-atomic; the
+        # async-signal context is the only concurrent writer and the step
+        # loop consumes the flag at boundaries, so a lost re-set at worst
+        # delays the drain by the one step the escalation path covers
         self._preempt_signal = name
 
     def _deliver_preempt(self, sig: int) -> None:
@@ -3336,6 +3352,16 @@ class Trainer:
     def _rollback(self, rb: _RollbackRequested) -> int:
         """Restore the last checkpoint after K consecutive bad steps;
         returns the epoch to continue from."""
+        # an in-flight async save snapshots the suspect regime and its
+        # step key may be re-reached after the replay: abandon it
+        # uncommitted (local-only; uniform because the rollback decision
+        # is broadcast-agreed below)
+        dropped = self.checkpointer.abandon_async()
+        if dropped is not None:
+            self.log.warning(
+                "rollback: abandoned in-flight async checkpoint of "
+                "step %d", dropped,
+            )
         step = self.checkpointer.latest_step()
         if coord.process_count() > 1:
             # every process must replay from the SAME snapshot; latest_step
@@ -3581,6 +3607,8 @@ class Trainer:
         if self.checkpointer is None:
             return
         stats = self._save_snapshot(epoch, epoch_step=0, mid_epoch=False)
+        if stats is None:  # async submission: event lands at commit
+            return
         self._emit_event(
             "checkpoint", epoch=int(epoch),
             iteration=int(self.iteration), mid_epoch=False, **stats,
@@ -3601,6 +3629,8 @@ class Trainer:
         stats = self._save_snapshot(
             epoch, epoch_step=epoch_step, mid_epoch=True, wait=wait,
         )
+        if stats is None:  # async submission: event lands at commit
+            return
         self._emit_event(
             "checkpoint", epoch=int(epoch), iteration=int(self.iteration),
             mid_epoch=True, epoch_step=int(epoch_step), **stats,
@@ -3611,6 +3641,35 @@ class Trainer:
         """Shard-native format unless the --ckpt-format replicated escape
         hatch (interchange with pre-ISSUE-13 consumers) is armed."""
         return getattr(self.config, "ckpt_format", "sharded") != "replicated"
+
+    def _poll_async_ckpt(
+        self, block: bool = False, durable: bool = False
+    ) -> None:
+        """Retire a completed in-flight async shard save (ISSUE 16): the
+        collective commit (payload barrier + p0 manifest + success vote)
+        runs HERE on the step-loop thread — the writer thread never
+        issues a group op — and the checkpoint event carries the real
+        submit-to-commit span plus the commit iteration, so the report
+        tool can tell how many steps each save overlapped."""
+        ck = self.checkpointer
+        if ck is None:
+            return
+        evt = ck.poll_async(block=block, durable=durable)
+        if evt is None:
+            return
+        meta = evt.get("meta") or {}
+        self._emit_event(
+            "checkpoint",
+            epoch=int(meta.get("epoch", 0)),
+            iteration=int(evt["step"]),
+            mid_epoch=bool(meta.get("mid_epoch", True)),
+            epoch_step=int(meta.get("epoch_step", 0)),
+            duration_s=float(evt["duration_s"]),
+            bytes=int(evt["bytes"]),
+            format="sharded",
+            commit_iteration=int(self.iteration),
+            **{"async": True},
+        )
 
     def _save_snapshot(
         self, epoch: int, epoch_step: int, mid_epoch: bool,
@@ -3624,12 +3683,30 @@ class Trainer:
         if self.meta.has_carry and self.carry is not None and mid_epoch:
             carry = self.carry
         if self._ckpt_sharded():
+            # retire any in-flight async save FIRST, from here (not from
+            # the checkpointer-internal drain), so its checkpoint event
+            # lands in the telemetry stream before the new save's; the
+            # preempt drain (wait=True) also upgrades that commit to the
+            # fsync'd rc-75 durability contract
+            self._poll_async_ckpt(block=True, durable=wait)
             manifest, files = self._shard_payload(
                 epoch, epoch_step, mid_epoch, carry
             )
-            stats = self.checkpointer.save_sharded(
-                manifest, files, wait=wait
-            )
+            # graft: group-uniform -- mid_epoch/wait are literal args at collective call sites; ckpt_async is static config
+            if (
+                mid_epoch and not wait
+                and getattr(self.config, "ckpt_async", True)
+            ):
+                # async path (ISSUE 16): the step-boundary snapshot is
+                # `files` itself — fresh host copies, handed over to the
+                # writer thread; only the group-agreed preamble runs here
+                stats = self.checkpointer.submit_sharded(manifest, files)
+                if stats is None:
+                    return None  # in flight; event lands at commit time
+            else:
+                stats = self.checkpointer.save_sharded(
+                    manifest, files, wait=wait
+                )
             return {
                 "duration_s": float(stats["duration_s"]),
                 "bytes": int(stats["bytes"]),
@@ -3948,6 +4025,18 @@ class Trainer:
 
     def close(self) -> None:
         if self.checkpointer is not None:
+            if coord.process_count() == 1:
+                # land the in-flight async save's commit AND its
+                # telemetry event before the stream closes; multi-host
+                # close is the disorderly path — the checkpointer
+                # abandons the uncommitted save rather than risk a
+                # collective against departed peers
+                try:
+                    self._poll_async_ckpt(block=True)
+                except RuntimeError:
+                    self.log.exception(
+                        "in-flight async checkpoint failed during close"
+                    )
             self.checkpointer.close()
         if self.writer is not None:
             self.writer.close()

@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "resize path); 'replicated' keeps the legacy "
                         "orbax gathered form for interchange with old "
                         "runs. Restore reads either format transparently")
+    p.add_argument("--no-ckpt-async", action="store_true",
+                   help="make mid-epoch shard-native checkpoints block "
+                        "the step loop (by default the payload write "
+                        "runs on a background thread and the commit "
+                        "lands at the next agree-interval step)")
     p.add_argument("--no-grad-guard", action="store_true",
                    help="disable the non-finite-gradient guard (by default "
                         "a NaN/inf gradient drops that update, emits a "
@@ -198,6 +203,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         overrides["augment"] = False
     if args.no_grad_guard:
         overrides["grad_guard"] = False
+    if args.no_ckpt_async:
+        overrides["ckpt_async"] = False
     if args.no_health_stats:
         overrides["health_stats"] = False
     if args.tensorboard:

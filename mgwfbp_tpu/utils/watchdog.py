@@ -76,6 +76,10 @@ class ProgressWatchdog:
     def enabled(self) -> bool:
         return self.timeout_s > 0
 
+    # graft: thread-safe -- lock-free heartbeat by design: stores are
+    # GIL-atomic and the watcher tolerates one stale/lenient check (see
+    # the _last/_allow ordering comment below); a lock here would let a
+    # wedged holder stall the very thread meant to detect wedges
     def beat(self, phase: str = "step", allow_s: float = 0.0) -> None:
         """Record progress. `allow_s` extends the deadline for the phase
         being ENTERED — known-long silent phases (first-step XLA compile

@@ -532,6 +532,68 @@ def test_port_file_written_with_actual_bound_port(tmp_path, monkeypatch):
         srv.close()
 
 
+def test_port_file_never_observed_truncated(tmp_path):
+    """Pin the sidecar's atomicity contract (ISSUE 16 satellite): the
+    supervisor's fleet fan-in polls this file while the training process
+    (re)writes it, so a reader racing the writer must see either a
+    COMPLETE old doc or a COMPLETE new doc — never a truncated or mixed
+    one. write_port_file commits via tmp + os.replace; this test hammers
+    the write from a thread while reading in a tight loop and fails on
+    any unparseable or partial observation (which an in-place open(
+    path, 'w') + json.dump would produce within a few hundred rounds)."""
+    import threading
+
+    from mgwfbp_tpu.telemetry.serve import write_port_file
+
+    class _Srv:  # the two attributes write_port_file reads
+        host = "127.0.0.1"
+        port = 0
+
+    path = str(tmp_path / "metrics_port.p0.json")
+    stop = threading.Event()
+
+    def hammer():
+        srv = _Srv()
+        port = 1024
+        while not stop.is_set():
+            srv.port = port = 1024 + (port - 1023) % 60000
+            write_port_file(path, srv, 0)
+
+    w = threading.Thread(target=hammer, daemon=True)
+    w.start()
+    try:
+        seen = 0
+        bad = []
+        while seen < 2000:
+            try:
+                with open(path) as f:
+                    raw = f.read()
+            except FileNotFoundError:  # before the first commit
+                continue
+            seen += 1
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                bad.append(raw)
+                break
+            # every committed doc is complete: all keys, coherent values
+            missing = {"process", "host", "bound_host", "port",
+                       "pid"} - set(doc)
+            if missing:
+                bad.append(f"missing {missing}: {raw}")
+                break
+            if not (1024 <= doc["port"] < 61024):
+                bad.append(raw)
+                break
+        assert not bad, f"reader observed a torn sidecar: {bad[0]!r}"
+    finally:
+        stop.set()
+        w.join(timeout=5)
+    # the tmp staging names never accumulate (os.replace consumed them)
+    leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    assert leftovers == []
+
+
 # ---------------------------------------------------------------------------
 # pinned: live /profile window on a real lenet CPU-mesh run
 # ---------------------------------------------------------------------------

@@ -7,41 +7,73 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/7] ruff =="
+echo "== [1/8] ruff =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mgwfbp_tpu tests tools bench.py || rc=1
 else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/7] mgwfbp_tpu.analysis (jit-safety lint -> SPMD lockstep checker -> schedule verifier) =="
-# cheapest-first inside the CLI: the RUN-family SPMD pass statically
-# proves the multi-host protocol balanced in ~1 s, so a coordination bug
-# fails HERE in seconds instead of hanging the multi-minute live smokes
-# below into their hard timeouts; the zero-finding state of the shipped
-# tree is pinned by this stage (ANA001 keeps the suppressions honest)
+echo "== [2/8] mgwfbp_tpu.analysis (jit-safety lint -> THR race checker -> SPMD lockstep checker -> schedule verifier) =="
+# cheapest-first inside the CLI: the THR host-concurrency pass and the
+# RUN-family SPMD pass statically prove the threading and the multi-host
+# protocol sound in ~1 s each, so a race/coordination bug fails HERE in
+# seconds instead of hanging the multi-minute live smokes below into
+# their hard timeouts; the zero-finding state of the shipped tree is
+# pinned by this stage (ANA001 keeps the suppressions honest)
 JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis || rc=1
+# the THR family's exit-code contract, end to end: a seeded
+# unlocked-shared-buffer probe must fail with exactly bit 32
+thr_probe="$(mktemp -t mgwfbp_thr_probe.XXXXXX.py)"
+trap 'rm -f "$thr_probe"' EXIT
+cat > "$thr_probe" <<'EOF'
+import threading
 
-echo "== [3/7] telemetry report smoke (writer -> report -> exports) =="
+
+class Buf:
+    def __init__(self):
+        self._rows = []
+        self._t = threading.Thread(target=self._drain)
+        self._t.start()
+
+    def _drain(self):
+        while True:
+            self._rows.pop()
+
+    def push(self, x):
+        self._rows.append(x)
+EOF
+JAX_PLATFORMS=cpu python -m mgwfbp_tpu.analysis \
+    --skip-lint --skip-spmd --skip-jaxpr "$thr_probe" >/dev/null 2>&1
+thr_rc=$?
+if [ "$thr_rc" -ne 32 ]; then
+    echo "THR seeded probe exited $thr_rc, want 32 (family bit) — the race gate is not wired" >&2
+    rc=1
+fi
+
+echo "== [3/8] telemetry report smoke (writer -> report -> exports) =="
 JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
 
-echo "== [4/7] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
+echo "== [4/8] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py || rc=1
 
-echo "== [5/7] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
+echo "== [5/8] async-checkpoint smoke (step-time envelope vs ckpt-off + async event contract) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --async-ckpt || rc=1
+
+echo "== [6/8] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
 # hard timeout: a coordination bug's failure mode is a distributed HANG —
 # and so is a fleet fan-in bug's — which must fail the gate, not wedge it
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --processes 2 || rc=1
 
-echo "== [6/7] elastic-resize smoke (supervisor-triggered drain -> relaunch at 1 process from the shard-native checkpoint -> resume to completion) =="
+echo "== [7/8] elastic-resize smoke (supervisor-triggered drain -> relaunch at 1 process from the shard-native checkpoint -> resume to completion) =="
 # same hard-timeout contract: a resize hang (re-shard deadlock, a child
 # that never finds the sibling checkpoint) must FAIL the gate, not wedge it
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --resize || rc=1
 
-echo "== [7/7] tier-1 tests =="
+echo "== [8/8] tier-1 tests =="
 t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
-trap 'rm -f "$t1log"' EXIT                 # must not clobber each other
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+trap 'rm -f "$t1log" "$thr_probe"' EXIT    # must not clobber each other
+timeout -k 10 1260 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly 2>&1 | tee "$t1log"
 t1=${PIPESTATUS[0]}

@@ -76,20 +76,27 @@ def _probe(port: int, path: str, timeout_s: float = 1.0):
         return None, str(e)
 
 
-def _cli(logdir: str) -> list[str]:
-    return [
+def _cli(
+    logdir: str, ckpt: bool = True, extra: tuple = (),
+) -> list[str]:
+    cmd = [
         sys.executable, "-m", "mgwfbp_tpu.train_cli",
         "--dnn", "lenet", "--synthetic", "--no-profile-backward",
         "--batch-size", "8", "--num-batches-per-epoch", "6",
         "--max-epochs", "2", "--epochs", "2", "--seed", "7",
         "--logdir", logdir,
-        "--checkpoint-dir", os.path.join(logdir, "ckpt"),
-        "--ckpt-every-steps", "2", "--telemetry",
     ]
+    if ckpt:
+        cmd += [
+            "--checkpoint-dir", os.path.join(logdir, "ckpt"),
+            "--ckpt-every-steps", "2",
+        ]
+    return cmd + ["--telemetry", *extra]
 
 
 def _run(
     logdir: str, fault_plan: str, metrics_port: int = 0,
+    ckpt: bool = True, extra: tuple = (),
 ) -> tuple[int, dict]:
     """One real-launcher run; with metrics_port > 0 the live plane is
     probed WHILE the run is up (mid-run, not post-hoc — that is the whole
@@ -109,7 +116,7 @@ def _run(
     out_path = os.path.join(logdir, "fault_smoke_child.log")
     with open(out_path, "w") as sink:
         proc = subprocess.Popen(
-            _cli(logdir), env=env, cwd=_ROOT,
+            _cli(logdir, ckpt=ckpt, extra=extra), env=env, cwd=_ROOT,
             stdout=sink, stderr=subprocess.STDOUT,
         )
         probes: dict = {}
@@ -539,6 +546,79 @@ def resize_smoke(processes: int = 2, resize_to: int = 1) -> dict:
         }
 
 
+def async_ckpt_smoke() -> dict:
+    """ISSUE 16: the async shard writer's cost + event contract, on two
+    clean (fault-free) runs. The async run must (a) write every
+    mid-epoch --ckpt-every-steps checkpoint through the background
+    writer (events carry async:true with the commit iteration), with at
+    least one payload write demonstrably overlapping training (commit
+    landing at a later iteration than the submit), and (b) keep
+    post-warmup step time within noise of a checkpoints-OFF run — the
+    step loop pays the shard-row snapshot and the group-agreed
+    preamble, never the np.save."""
+    from mgwfbp_tpu.telemetry import events_of
+
+    def _post_warmup_median_step_s(d: str) -> float:
+        steps = sorted(
+            events_of(_events(d), "step"), key=lambda r: r["step"]
+        )
+        assert len(steps) >= 8, f"run too short: {len(steps)} steps"
+        durs = sorted(float(r["dur_s"]) for r in steps[2:])
+        return durs[len(durs) // 2]
+
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_async_off_") as d:
+        rc, _ = _run(d, "", ckpt=False)
+        assert rc == 0, f"ckpt-off run exited rc {rc}"
+        off_median = _post_warmup_median_step_s(d)
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_async_on_") as d:
+        rc, _ = _run(d, "")
+        assert rc == 0, f"async-ckpt run exited rc {rc}"
+        on_median = _post_warmup_median_step_s(d)
+        recs = _events(d)
+        mids = [
+            c for c in events_of(recs, "checkpoint")
+            if c.get("mid_epoch")
+        ]
+        assert mids, "no mid-epoch checkpoint events"
+        assert all(c.get("async") for c in mids), (
+            f"mid-epoch saves bypassed the async writer: {mids}"
+        )
+        assert all(
+            int(c["commit_iteration"]) >= int(c["iteration"])
+            for c in mids
+        ), mids
+        overlapped = [
+            c for c in mids
+            if int(c["commit_iteration"]) > int(c["iteration"])
+        ]
+        assert overlapped, (
+            "every async save committed within its own submit step — "
+            f"the payload write never overlapped training: {mids}"
+        )
+        # durations span submit -> commit, so each overlapping save's
+        # duration covers at least the steps it rode over
+        assert all(float(c["duration_s"]) > 0 for c in mids), mids
+    # "within noise": a generous envelope (CPU CI boxes jitter), but one
+    # a synchronous world-blocking save would still trip if the payload
+    # write sat on the step path for a multi-ms np.save per 2 steps
+    assert on_median <= off_median * 3.0 + 0.05, (
+        f"async-ckpt median step {on_median * 1e3:.2f} ms vs ckpt-off "
+        f"{off_median * 1e3:.2f} ms — the writer is back on the step "
+        "path"
+    )
+    return {
+        "async_ckpt_smoke": "ok",
+        "ckpt_off_median_step_ms": round(off_median * 1e3, 3),
+        "async_median_step_ms": round(on_median * 1e3, 3),
+        "async_saves": len(mids),
+        "overlapping_saves": len(overlapped),
+        "max_overlap_steps": max(
+            int(c["commit_iteration"]) - int(c["iteration"])
+            for c in mids
+        ),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--processes", type=int, default=1,
@@ -550,8 +630,15 @@ def main() -> int:
                          "group drained by the supervisor's --resize-to "
                          "policy, relaunched at 1 process from the "
                          "shard-native checkpoint, resumed to completion")
+    ap.add_argument("--async-ckpt", action="store_true",
+                    dest="async_ckpt",
+                    help="async shard-writer lifecycle (ISSUE 16): "
+                         "checkpoints-off vs async-ckpt step-time "
+                         "envelope + async checkpoint event contract")
     args = ap.parse_args()
-    if args.resize:
+    if args.async_ckpt:
+        out = async_ckpt_smoke()
+    elif args.resize:
         out = resize_smoke(max(args.processes, 2), 1)
     elif args.processes > 1:
         out = multi_process(args.processes)

@@ -201,13 +201,7 @@ def format_report(records: list[dict]) -> str:
         ("resize", lambda r: (
             f"resize {r.get('old_world')} -> {r.get('new_world')} "
             f"({r.get('schedule_source')}, {r.get('num_groups')} groups)")),
-        ("checkpoint", lambda r: (
-            f"checkpoint epoch {r.get('epoch')} iter {r.get('iteration')}"
-            + (
-                f" [{r.get('format')} {_fmt_s(r.get('duration_s'))} s, "
-                f"{int(r.get('bytes', 0)) // 1024} KiB/proc]"
-                if r.get("duration_s") is not None else ""
-            ))),
+        ("checkpoint", _ckpt_line),
         ("autotune_race", lambda r: (
             f"autotune race {r.get('label')}: "
             f"{_fmt_s(r.get('measured_step_s'))} s/step "
@@ -242,7 +236,66 @@ def format_report(records: list[dict]) -> str:
         lines.append("")
         lines.append("lifecycle:")
         lines.extend(f"  {s}" for s in lifecycle)
+
+    # checkpoint save-duration trend (ISSUE 16): creeping save cost is a
+    # regression signal (state growth, fs contention), and a save whose
+    # async payload write overlapped more than one optimizer step is
+    # worth surfacing — that is the writer earning its keep, or, when
+    # the overlap keeps growing, the writer falling behind the cadence
+    saves = [
+        r for r in events_of(records, "checkpoint")
+        if r.get("duration_s") is not None
+    ]
+    if saves:
+        durs = [float(r["duration_s"]) for r in saves]
+        n_async = sum(1 for r in saves if r.get("async"))
+        lines.append("")
+        lines.append(
+            f"checkpoint saves ({len(saves)}, {n_async} async):"
+        )
+        half = len(durs) // 2
+        trend = ""
+        if half >= 1 and len(durs) >= 4:
+            early = sum(durs[:half]) / half
+            late = sum(durs[half:]) / (len(durs) - half)
+            trend = (
+                f", trend {_fmt_s(early)} -> {_fmt_s(late)} s"
+                + (" [REGRESSING]" if late > 1.5 * early else "")
+            )
+        lines.append(
+            f"  duration mean {_fmt_s(sum(durs) / len(durs))} s, "
+            f"max {_fmt_s(max(durs))} s{trend}"
+        )
+        for r in saves:
+            ov = _ckpt_overlap_steps(r)
+            if ov > 1:
+                lines.append(
+                    f"  save at iter {r.get('iteration')} overlapped "
+                    f"{ov} steps (committed at iter "
+                    f"{r.get('commit_iteration')})"
+                )
     return "\n".join(lines)
+
+
+def _ckpt_overlap_steps(r: dict) -> int:
+    """Steps the async payload write spanned: submit iteration to commit
+    iteration (0 for synchronous saves, which block the loop)."""
+    if not r.get("async") or r.get("commit_iteration") is None:
+        return 0
+    return int(r["commit_iteration"]) - int(r.get("iteration", 0))
+
+
+def _ckpt_line(r: dict) -> str:
+    s = f"checkpoint epoch {r.get('epoch')} iter {r.get('iteration')}"
+    if r.get("duration_s") is not None:
+        s += (
+            f" [{r.get('format')} {_fmt_s(r.get('duration_s'))} s, "
+            f"{int(r.get('bytes', 0)) // 1024} KiB/proc]"
+        )
+    if r.get("async"):
+        ov = _ckpt_overlap_steps(r)
+        s += f" [async, +{ov} step(s) to commit]"
+    return s
 
 
 def _ewma(values: list[float], alpha: float = 0.1):
@@ -589,6 +642,14 @@ def _synthetic_stream(path: str) -> None:
     w.emit("resize", old_world=8, new_world=4,
            schedule_source="schedule-cache", num_groups=2)
     w.emit("checkpoint", epoch=0, iteration=24, mid_epoch=False)
+    # async shard-native saves (ISSUE 16): one committed at the next
+    # cadence step, one whose payload write overlapped three steps
+    w.emit("checkpoint", epoch=0, iteration=8, mid_epoch=True,
+           epoch_step=8, duration_s=0.030, bytes=1 << 20,
+           format="sharded", commit_iteration=9, **{"async": True})
+    w.emit("checkpoint", epoch=0, iteration=16, mid_epoch=True,
+           epoch_step=16, duration_s=0.140, bytes=1 << 20,
+           format="sharded", commit_iteration=19, **{"async": True})
     w.emit("drift_alarm", kind="comm_residual", step=20, residual=4.5,
            band=3.0, active=True, group=1)
     w.emit("drift_alarm", kind="comm_residual", step=23, residual=1.2,
@@ -635,6 +696,16 @@ def selftest() -> int:
         assert "postmortem bundles (1):" in report, report
         assert "/tmp/run/postmortems/0000" in report, report
         assert "gnorm_first" in report, report
+        # ISSUE 16: the save-duration trend section renders, async saves
+        # are marked in the lifecycle, and the save whose payload write
+        # spanned >1 step is flagged with its commit iteration
+        assert "checkpoint saves (2, 2 async):" in report, report
+        assert "[async, +1 step(s) to commit]" in report, report
+        assert (
+            "save at iter 16 overlapped 3 steps (committed at iter 19)"
+            in report
+        ), report
+        assert "save at iter 8 overlapped" not in report, report
         trace_path = os.path.join(d, "trace.json")
         doc = write_chrome_trace(trace_path, records)
         with open(trace_path) as f:
