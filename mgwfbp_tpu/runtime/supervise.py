@@ -16,10 +16,15 @@ any other failure tears down the stragglers and propagates.
 from __future__ import annotations
 
 import argparse
+import shlex
 import sys
 from typing import Optional
 
-from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
+from mgwfbp_tpu.runtime.supervisor import (
+    Supervisor,
+    default_serve_cmd,
+    default_train_cmd,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -73,6 +78,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "completed step); the relaunched incarnation "
                         "resumes from the exact step — shard-native "
                         "checkpoints re-shard onto the new world size")
+    p.add_argument("--serve-replicas", dest="serve_replicas", type=int,
+                   default=0,
+                   help="spawn this many hot-reload serving replicas "
+                        "(python -m mgwfbp_tpu.serving) alongside the "
+                        "training group; replicas live for the whole "
+                        "supervisor run (resubmits/resizes do not churn "
+                        "them) and join the fleet under the serve role "
+                        "on role-offset metrics ports")
+    p.add_argument("--serve-args", dest="serve_args", default=None,
+                   help="arguments for the serving CLI, one shell-quoted "
+                        "string (e.g. --serve-args '--dnn lenet "
+                        "--checkpoint-dir ckpts --shadow')")
     p.add_argument("train_args", nargs=argparse.REMAINDER,
                    help="arguments for mgwfbp_tpu.train_cli (prefix "
                         "with --)")
@@ -97,6 +114,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         fleet_port=args.fleet_port,
         fleet_file=args.fleet_file,
         resize_to=args.resize_to,
+        serve_replicas=args.serve_replicas,
+        serve_cmd=(
+            default_serve_cmd(shlex.split(args.serve_args or ""))
+            if args.serve_replicas else None
+        ),
     )
     return sup.run()
 

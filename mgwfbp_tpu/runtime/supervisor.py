@@ -136,12 +136,20 @@ class Supervisor:
         fleet_port: Optional[int] = None,
         fleet_file: Optional[str] = None,
         resize_to: Optional[int] = None,
+        serve_replicas: int = 0,
+        serve_cmd: Optional[Sequence[str]] = None,
         sleep: Callable[[float], None] = time.sleep,
     ):
         if processes < 1:
             raise ValueError(f"processes must be >= 1, got {processes}")
         if resize_to is not None and resize_to < 1:
             raise ValueError(f"resize_to must be >= 1, got {resize_to}")
+        if serve_replicas < 0:
+            raise ValueError(
+                f"serve_replicas must be >= 0, got {serve_replicas}"
+            )
+        if serve_replicas and not serve_cmd:
+            raise ValueError("serve_replicas > 0 needs a serve_cmd")
         self.base_cmd = list(base_cmd)
         self.processes = int(processes)
         self.max_restarts = int(max_restarts)
@@ -181,6 +189,16 @@ class Supervisor:
         self._resize_signaled = False
         self._resize_poll_t = 0.0
         self._resize_no_metrics_warned = False
+        # serving replicas (ISSUE 19): spawned ONCE for the supervisor's
+        # lifetime — they hot-reload checkpoints across incarnations, so
+        # a training-group resubmit/resize must not churn them. Excluded
+        # from the rc policy (a dead replica degrades serving, never the
+        # training job); folded into the fleet under the `serve` role.
+        self.serve_replicas = int(serve_replicas)
+        self.serve_cmd = list(serve_cmd) if serve_cmd else None
+        self._serve_procs: list = []
+        self._serve_logs: list = []
+        self._serve_exit_warned: set = set()
 
     # -- launch ------------------------------------------------------------
     def _metrics_base_port(self) -> Optional[int]:
@@ -208,9 +226,11 @@ class Supervisor:
         except ValueError:
             return False
 
-    def _port_file(self, idx: int) -> str:
+    def _port_file(self, idx: int, role: str = "train") -> str:
         """Per-child metrics port-file sidecar path (the child's
-        telemetry/serve writes its ACTUAL bound port there)."""
+        telemetry/serve writes its ACTUAL bound port there). Role-aware:
+        serve replicas get their own `metrics_port.serve{i}.json`
+        namespace so replica i never clobbers training child i's file."""
         if self._ports_dir is None:
             if self.log_dir:
                 self._ports_dir = self.log_dir
@@ -221,7 +241,8 @@ class Supervisor:
                 self._ports_dir = tempfile.mkdtemp(
                     prefix="mgwfbp_fleet_ports_"
                 )
-        return os.path.join(self._ports_dir, f"metrics_port.p{idx}.json")
+        stem = f"serve{idx}" if role == "serve" else f"p{idx}"
+        return os.path.join(self._ports_dir, f"metrics_port.{stem}.json")
 
     def _child_targets(self) -> dict:
         """process index -> (host, port) of every currently-resolvable
@@ -249,7 +270,34 @@ class Supervisor:
                 pass
             if base is not None:
                 targets[i] = ("127.0.0.1", base + i)
+        if self.serve_replicas:
+            from mgwfbp_tpu.telemetry.serve import resolve_metrics_port
+
+            for i in range(self.serve_replicas):
+                key = f"serve{i}"
+                path = self._port_file(i, role="serve")
+                try:
+                    with open(path) as f:
+                        doc = _json.load(f)
+                    targets[key] = (
+                        str(doc.get("host") or "127.0.0.1"),
+                        int(doc["port"]),
+                    )
+                    continue
+                except (OSError, ValueError, KeyError, TypeError):
+                    pass
+                if base is not None:
+                    # the serve offset keeps replica ports disjoint from
+                    # the training children's base+index band
+                    targets[key] = (
+                        "127.0.0.1",
+                        resolve_metrics_port(base, i, role="serve"),
+                    )
         return targets
+
+    @staticmethod
+    def _target_role(key) -> str:
+        return "serve" if isinstance(key, str) else "train"
 
     def _refresh_fleet(self) -> None:
         """Re-resolve the child target map; persist `fleet.json`
@@ -265,7 +313,10 @@ class Supervisor:
             from mgwfbp_tpu.telemetry.fleet import write_fleet_sd
 
             try:
-                write_fleet_sd(self.fleet_file, targets)
+                write_fleet_sd(
+                    self.fleet_file, targets,
+                    roles={k: self._target_role(k) for k in targets},
+                )
             except OSError as e:
                 # do NOT record the targets: the sidecar is stale, and a
                 # stable group would otherwise never retry the write
@@ -277,8 +328,10 @@ class Supervisor:
             self.log.info(
                 "fleet targets -> %s (%s)", self.fleet_file,
                 ", ".join(
-                    f"p{i}={h}:{p}"
-                    for i, (h, p) in sorted(targets.items())
+                    f"{'' if isinstance(i, str) else 'p'}{i}={h}:{p}"
+                    for i, (h, p) in sorted(
+                        targets.items(), key=lambda kv: str(kv[0])
+                    )
                 ),
             )
         self._last_fleet_targets = dict(targets)
@@ -289,6 +342,13 @@ class Supervisor:
             "incarnation": len(self.results),
             "processes_configured": self.processes,
         }
+        if self.serve_replicas:
+            meta["serving"] = {
+                "replicas": self.serve_replicas,
+                "alive": sum(
+                    1 for p in self._serve_procs if p.poll() is None
+                ),
+            }
         if self.resize_to is not None:
             # the transition is fleet-visible: pending while the group
             # still runs at the old size, done once an incarnation
@@ -434,6 +494,88 @@ class Supervisor:
             stderr=stderr,
         ), stdout
 
+    # -- serving replicas (ISSUE 19) ---------------------------------------
+    def _serve_env(self, idx: int) -> dict:
+        """A serve replica is NOT a member of the training group: it gets
+        no coordinator contract (and any inherited one is stripped so a
+        replica never tries to join jax.distributed), just its replica
+        index and the role-aware port file."""
+        env = dict(self.env)
+        for k in (
+            "MGWFBP_COORDINATOR",
+            "MGWFBP_NUM_PROCESSES",
+            "MGWFBP_PROCESS_ID",
+        ):
+            env.pop(k, None)
+        env["MGWFBP_SERVE_REPLICA"] = str(idx)
+        if self._metrics_enabled():
+            env["MGWFBP_METRICS_PORT_FILE"] = self._port_file(
+                idx, role="serve"
+            )
+            if self.fleet_port is not None or self._fleet_file_explicit:
+                env.setdefault("MGWFBP_METRICS_HOST", "0.0.0.0")
+        return env
+
+    def _start_serve_replicas(self) -> None:
+        """Spawn the serve replicas once, for the supervisor's lifetime
+        (training-group resubmits and resizes must not churn them — each
+        replica hot-reloads committed checkpoints on its own)."""
+        if not self.serve_replicas or self._serve_procs:
+            return
+        base = self._metrics_base_port()
+        for i in range(self.serve_replicas):
+            if self._metrics_enabled():
+                try:
+                    os.unlink(self._port_file(i, role="serve"))
+                except OSError:
+                    pass
+            stdout = stderr = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(
+                    os.path.join(self.log_dir, f"serve{i}.log"),
+                    "w", buffering=1,
+                )
+                stderr = subprocess.STDOUT
+            self._serve_procs.append(subprocess.Popen(
+                self.serve_cmd,
+                env=self._serve_env(i),
+                stdout=stdout,
+                stderr=stderr,
+            ))
+            self._serve_logs.append(stdout)
+            if base is not None:
+                from mgwfbp_tpu.telemetry.serve import resolve_metrics_port
+
+                self.log.info(
+                    "serve replica %d metrics at http://127.0.0.1:%d "
+                    "(/metrics /status, POST /predict)",
+                    i, resolve_metrics_port(base, i, role="serve"),
+                )
+
+    def _reap_serve_replicas(self) -> None:
+        """A dead replica degrades serving capacity but never the
+        training job: warn once per replica, keep the group running."""
+        for i, p in enumerate(self._serve_procs):
+            if p.poll() is not None and i not in self._serve_exit_warned:
+                self._serve_exit_warned.add(i)
+                self.log.warning(
+                    "serve replica %d exited rc %d (training continues; "
+                    "replica is NOT restarted%s)",
+                    i, p.returncode,
+                    f" — see {self.log_dir}/serve{i}.log"
+                    if self.log_dir else "",
+                )
+
+    def _stop_serve_replicas(self) -> None:
+        if self._serve_procs:
+            self._teardown(self._serve_procs)
+        for f in self._serve_logs:
+            if f is not None:
+                f.close()
+        self._serve_procs = []
+        self._serve_logs = []
+
     def _run_group(self, incarnation: int) -> GroupResult:
         self._status_snapshots = None  # fresh capture per incarnation
         port = self.port if self.port is not None else free_port()
@@ -493,6 +635,7 @@ class Supervisor:
             # keep the fleet.json sidecar current (no-op when the live
             # plane is off or nothing changed)
             self._refresh_fleet()
+            self._reap_serve_replicas()
             # --resize-to: drain a healthy group once it is stepping
             self._maybe_trigger_resize(procs)
             pending = [p for p in procs if p.poll() is None]
@@ -559,8 +702,10 @@ class Supervisor:
 
     def run(self) -> int:
         try:
+            self._start_serve_replicas()
             return self._run_policy()
         finally:
+            self._stop_serve_replicas()
             if self.fleet_server is not None:
                 self.fleet_server.close()
                 self.fleet_server = None
@@ -673,3 +818,9 @@ def default_train_cmd(train_args: Sequence[str]) -> list[str]:
     """The per-process command for a training group: this interpreter,
     this repo's launcher, the user's args verbatim."""
     return [sys.executable, "-m", "mgwfbp_tpu.train_cli", *train_args]
+
+
+def default_serve_cmd(serve_args: Sequence[str]) -> list[str]:
+    """The per-replica command for `--serve-replicas`: the standalone
+    serving CLI; the replica index rides in MGWFBP_SERVE_REPLICA."""
+    return [sys.executable, "-m", "mgwfbp_tpu.serving", *serve_args]

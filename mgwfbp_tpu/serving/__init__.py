@@ -1,0 +1,46 @@
+"""Online inference plane riding the training runtime (ISSUE 19).
+
+The training side already built everything a serving plane needs: a
+committed, manifest-addressed checkpoint format readable one leaf at a
+time (checkpoint.ShardSource, ISSUE 13/16), a per-process HTTP plane
+with a single metric registry (telemetry/serve.py, ISSUE 9/10), and a
+supervisor that owns child lifecycles (runtime/supervisor.py). This
+package adds the missing consumer:
+
+  * ``ServingModel`` (model.py) — the jitted ``apply_fn`` on a sharded
+    inference mesh plus an atomically-swapped live snapshot of the
+    newest committed checkpoint's params (hot-reload).
+  * ``ReloadWatcher`` (watch.py) — polls the checkpoint directory's
+    committed steps and drives the swap; emits ``reload`` events.
+  * ``PredictService`` (service.py) — bounded request queue + dispatcher
+    thread packing requests into fixed batch slots (continuous
+    micro-batching, deadline-or-full flush); answers POST ``/predict``
+    on the existing telemetry server.
+  * ``ShadowScorer`` (shadow.py) — scores a deterministic held-out
+    stream against each newly served checkpoint (``shadow_eval``
+    events, served-vs-training loss gauge).
+  * ``ServePlane`` (plane.py) — wires the four together; the trainer
+    embeds one under ``--serve-shadow``, ``python -m mgwfbp_tpu.serving``
+    runs one standalone, and ``supervise --serve-replicas N`` scales
+    them.
+
+No code in this package ever issues a collective: every device
+interaction is replicate-onto-mesh ``device_put`` plus a jitted forward,
+so any thread (watcher, dispatcher) may run it without violating the
+PR-16 owning-thread discipline for collectives.
+"""
+
+from mgwfbp_tpu.serving.model import ServingModel, committed_sharded_steps
+from mgwfbp_tpu.serving.plane import ServePlane
+from mgwfbp_tpu.serving.service import PredictService
+from mgwfbp_tpu.serving.shadow import ShadowScorer
+from mgwfbp_tpu.serving.watch import ReloadWatcher
+
+__all__ = [
+    "PredictService",
+    "ReloadWatcher",
+    "ServePlane",
+    "ServingModel",
+    "ShadowScorer",
+    "committed_sharded_steps",
+]

@@ -1,0 +1,293 @@
+"""Serving model plane: jitted forward + hot-reloadable param snapshot.
+
+The load path is the manifest-addressed ``ShardSource`` reader from the
+shard-native checkpoint format (ISSUE 13): one full leaf at a time off
+the memmapped shard files — never a world-sized buffer — regardless of
+whether the saver stored params sharded (rs_opt_ag / rs_fwd_ag carries)
+or replicated. The swap is one reference store of an immutable
+``LiveSnapshot`` behind a lock: a request thread that grabbed the old
+snapshot keeps computing on the old params, a request after the swap
+sees the new ones — there is no state in between, which is exactly the
+torn-read guarantee the concurrency hammer test pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from mgwfbp_tpu.checkpoint import (
+    MANIFEST_FILE,
+    SHARD_FORMAT_VERSION,
+    SHARD_SUBDIR,
+    CheckpointRestoreError,
+    ShardSource,
+)
+from mgwfbp_tpu.parallel.mesh import DATA_AXIS, MeshSpec, make_mesh
+from mgwfbp_tpu.utils.logging import get_logger
+
+SERVE_MAX_BATCH_ENV = "MGWFBP_SERVE_MAX_BATCH"
+DEFAULT_MAX_BATCH = 8
+
+log = get_logger("mgwfbp.serving.model")
+
+
+def committed_sharded_steps(directory: str) -> list[int]:
+    """Committed shard-native steps under a checkpoint directory, sorted.
+    Commit is the atomic manifest rename, so manifest-present == safely
+    readable; orbax-format steps are NOT listed (the serving reader is
+    manifest-addressed by design — no orbax manager in the request
+    path)."""
+    shard_root = os.path.join(directory, SHARD_SUBDIR)
+    out = []
+    try:
+        names = os.listdir(shard_root)
+    except OSError:
+        return []
+    for name in names:
+        if name.isdigit() and os.path.exists(
+            os.path.join(shard_root, name, MANIFEST_FILE)
+        ):
+            out.append(int(name))
+    return sorted(out)
+
+
+def open_committed_step(directory: str, step: int) -> tuple[ShardSource, float]:
+    """Validated reader over one committed shard-native step WITHOUT
+    constructing a Checkpointer (no orbax manager — the watcher must not
+    contend with the training process's own manager on the same
+    directory). Returns (source, commit wall time) where the commit time
+    is the manifest's mtime — the atomic-rename instant that made the
+    step visible, i.e. the start of the reload-lag clock."""
+    step_dir = os.path.join(directory, SHARD_SUBDIR, f"{int(step):08d}")
+    path = os.path.join(step_dir, MANIFEST_FILE)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        commit_wall = os.path.getmtime(path)
+    except (OSError, ValueError) as e:
+        raise CheckpointRestoreError(
+            f"shard-native checkpoint step {step} in {directory!r} has no "
+            f"readable manifest ({e}) — the save never committed or the "
+            "directory is torn"
+        ) from e
+    if manifest.get("format_version") != SHARD_FORMAT_VERSION:
+        raise CheckpointRestoreError(
+            f"shard-native checkpoint step {step} in {directory!r} has "
+            f"format_version {manifest.get('format_version')!r}; this "
+            f"build reads version {SHARD_FORMAT_VERSION}"
+        )
+    src = ShardSource(step_dir, manifest)
+    src.validate()
+    return src, commit_wall
+
+
+@dataclasses.dataclass(frozen=True)
+class LiveSnapshot:
+    """One served checkpoint: immutable by construction, swapped whole.
+    `step` is the train step the params came from — every response built
+    against this snapshot reports it as ``served_step``."""
+
+    params: Any
+    batch_stats: Any
+    step: int
+    commit_wall: float  # manifest commit instant (wall clock)
+    loaded_wall: float  # when the swap landed
+
+
+class ServingModel:
+    """The jitted forward on an inference mesh + the hot-reload seam.
+
+    ``run_padded`` is the ONLY compute path: the dispatcher packs every
+    flush into the same fixed ``max_batch`` slot (one compiled shape),
+    and the bitwise acceptance test calls it directly with the same
+    padding — so a served answer and a direct forward on the same
+    checkpoint cannot differ.
+    """
+
+    def __init__(
+        self,
+        module,
+        meta,
+        mesh=None,
+        max_batch: Optional[int] = None,
+    ):
+        if meta.has_carry:
+            raise ValueError(
+                f"model {meta.name!r} carries BPTT state; stateful "
+                "serving is not supported (serve a carry-free model)"
+            )
+        if meta.task == "ctc":
+            raise ValueError(
+                f"model {meta.name!r} is a CTC audio model; /predict "
+                "serves classify and carry-free lm tasks only"
+            )
+        if max_batch is None:
+            max_batch = int(
+                os.environ.get(SERVE_MAX_BATCH_ENV) or DEFAULT_MAX_BATCH
+            )
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.module = module
+        self.meta = meta
+        self.max_batch = int(max_batch)
+        self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
+        dummy = jnp.zeros(
+            (self.max_batch,) + tuple(meta.input_shape), meta.input_dtype
+        )
+        self.input_np_dtype = np.dtype(np.asarray(dummy).dtype)
+        variables = module.init(
+            {"params": jax.random.PRNGKey(0)}, dummy, train=False
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        self._has_batch_stats = bool(
+            jax.tree_util.tree_leaves(batch_stats)
+        )
+        self._params_treedef = jax.tree_util.tree_structure(params)
+        self._param_leaves = [
+            jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(params)
+        ]
+        self._bs_treedef = jax.tree_util.tree_structure(batch_stats)
+        self._bs_leaves = [
+            jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(batch_stats)
+        ]
+        # replicate-onto-mesh shardings: params replicated; the batch
+        # rides the data axis when the fixed slot divides it (the
+        # "sharded inference mesh"), else replicated too. Neither path
+        # issues a collective on load — device_put only.
+        self._rep = NamedSharding(self.mesh, PartitionSpec())
+        data_extent = int(self.mesh.shape[DATA_AXIS])
+        if self.max_batch % data_extent == 0 and data_extent > 1:
+            self._x_sharding = NamedSharding(
+                self.mesh, PartitionSpec(DATA_AXIS)
+            )
+        else:
+            self._x_sharding = self._rep
+        self._fwd = jax.jit(self._forward)
+        self._lock = threading.Lock()
+        self._live: Optional[LiveSnapshot] = None
+
+    def _forward(self, params, batch_stats, x):
+        variables = {"params": params}
+        if self._has_batch_stats:
+            variables["batch_stats"] = batch_stats
+        out = self.module.apply(variables, x, train=False)
+        if isinstance(out, tuple):  # aux-logit heads (googlenet style)
+            out = out[0]
+        return out
+
+    # -- hot-reload seam ---------------------------------------------------
+    def snapshot(self) -> Optional[LiveSnapshot]:
+        with self._lock:
+            return self._live
+
+    def served_step(self) -> Optional[int]:
+        snap = self.snapshot()
+        return None if snap is None else snap.step
+
+    def install_source(
+        self, src: ShardSource, step: int, commit_wall: float
+    ) -> LiveSnapshot:
+        """Load one committed step's params off the manifest reader and
+        swap it live. Leaf order is the tree_leaves order of this
+        module's init — the same order the trainer's ``_params_template``
+        gave the saver, so index j addresses the same leaf on both
+        sides; shapes/dtypes are still checked leaf-by-leaf to fail a
+        wrong---dnn mismatch loudly instead of serving garbage."""
+        params = self._read_section(
+            src, "params", self._param_leaves, self._params_treedef
+        )
+        if self._has_batch_stats:
+            if src.section_kind("batch_stats") == "none":
+                raise CheckpointRestoreError(
+                    f"checkpoint step {step}: model "
+                    f"{self.meta.name!r} has batch_stats but the "
+                    "manifest carries none — saved from a different "
+                    "model"
+                )
+            batch_stats = self._read_section(
+                src, "batch_stats", self._bs_leaves, self._bs_treedef
+            )
+        else:
+            batch_stats = jax.tree_util.tree_unflatten(
+                self._bs_treedef, []
+            )
+        snap = LiveSnapshot(
+            params=params,
+            batch_stats=batch_stats,
+            step=int(step),
+            commit_wall=float(commit_wall),
+            loaded_wall=time.time(),
+        )
+        with self._lock:
+            self._live = snap
+        return snap
+
+    def load_step(self, directory: str, step: int) -> LiveSnapshot:
+        src, commit_wall = open_committed_step(directory, step)
+        return self.install_source(src, step, commit_wall)
+
+    def _read_section(self, src, section, template, treedef):
+        docs = src.section_docs(section)
+        if len(docs) != len(template):
+            raise CheckpointRestoreError(
+                f"checkpoint {src.step_dir!r}: {section} has "
+                f"{len(docs)} leaves, model {self.meta.name!r} expects "
+                f"{len(template)} — saved from a different model"
+            )
+        leaves = []
+        for j, (doc, ref) in enumerate(zip(docs, template)):
+            if tuple(doc.get("shape", ())) != tuple(ref.shape):
+                raise CheckpointRestoreError(
+                    f"checkpoint {src.step_dir!r}: {section} leaf {j} "
+                    f"has shape {tuple(doc.get('shape', ()))}, model "
+                    f"expects {tuple(ref.shape)} — saved from a "
+                    "different model"
+                )
+            host = src.read_leaf(section, j)
+            leaves.append(jax.device_put(host, self._rep))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- the one compute path ----------------------------------------------
+    def run_padded(self, x: np.ndarray) -> tuple[np.ndarray, int]:
+        """Forward `x` (n <= max_batch examples) through the live
+        snapshot: pads to the fixed slot, runs the single compiled
+        forward, slices the padding back off. Returns (outputs, the
+        served train step). The snapshot is read ONCE — every example in
+        the call is answered by the same checkpoint."""
+        snap = self.snapshot()
+        if snap is None:
+            raise RuntimeError("no checkpoint served yet")
+        x = np.asarray(x, self.input_np_dtype)
+        want = tuple(self.meta.input_shape)
+        if x.ndim != len(want) + 1 or tuple(x.shape[1:]) != want:
+            raise ValueError(
+                f"inputs must be (n, {', '.join(map(str, want))}), "
+                f"got {tuple(x.shape)}"
+            )
+        n = int(x.shape[0])
+        if not 1 <= n <= self.max_batch:
+            raise ValueError(
+                f"batch of {n} examples exceeds the serve slot "
+                f"({self.max_batch}); split the request"
+            )
+        if n < self.max_batch:
+            pad = np.zeros(
+                (self.max_batch - n,) + want, self.input_np_dtype
+            )
+            x = np.concatenate([x, pad], axis=0)
+        xd = jax.device_put(x, self._x_sharding)
+        out = self._fwd(snap.params, snap.batch_stats, xd)
+        return np.asarray(jax.device_get(out))[:n], snap.step

@@ -141,6 +141,22 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # recorder.py): `trigger` names the alarm event that tripped it,
     # `step` the trigger's step, `path` the bundle directory
     "postmortem": ("trigger", "step", "path"),
+    # --- serving plane (ISSUE 19) ---------------------------------------
+    # the serving model hot-reloaded a newly committed shard-native
+    # checkpoint: `step` the served train step after the swap, `lag_s`
+    # commit-to-served latency (manifest mtime -> swap), `duration_s` the
+    # load+install time itself
+    "reload": ("step", "lag_s", "duration_s"),
+    # shadow-eval scored the held-out stream against a freshly served
+    # checkpoint; `train_loss` rides as an extra when the emitter knows it
+    # so the report can plot served-vs-training loss from the stream alone
+    "shadow_eval": ("step", "loss"),
+    # periodic request-plane snapshot from the dispatcher: `requests` is
+    # the CUMULATIVE served-request count, queue_depth the bounded queue's
+    # instantaneous depth, batch_fill the mean fill ratio of flushed batch
+    # slots since the last snapshot; latency quantiles ride as extras
+    # (latency_p50_s/p95_s/p99_s over the recent-request window)
+    "serve_stats": ("requests", "queue_depth", "batch_fill"),
 }
 
 _JSON_SCALARS = (str, int, float, bool, type(None))

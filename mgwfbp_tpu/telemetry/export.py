@@ -262,6 +262,31 @@ METRICS: tuple[tuple[str, str, str], ...] = (
      "training-health alarms raised (telemetry.health)"),
     ("mgwfbp_postmortems_total", "counter",
      "flight-recorder postmortem bundles written"),
+    # serving plane (ISSUE 19): request plane + hot-reload + shadow-eval
+    ("mgwfbp_serve_requests_total", "counter",
+     "predict requests served (cumulative, from serve_stats snapshots)"),
+    ("mgwfbp_serve_reloads_total", "counter",
+     "serving hot-reloads of a committed checkpoint"),
+    ("mgwfbp_shadow_evals_total", "counter",
+     "shadow-eval scores against freshly served checkpoints"),
+    ("mgwfbp_serve_step", "gauge",
+     "train step of the currently served checkpoint"),
+    ("mgwfbp_serve_reload_lag_seconds", "gauge",
+     "latest commit-to-served hot-reload lag"),
+    ("mgwfbp_serve_queue_depth", "gauge",
+     "predict request queue depth (latest dispatcher snapshot)"),
+    ("mgwfbp_serve_batch_fill", "gauge",
+     "mean fill ratio of flushed predict batch slots (latest snapshot)"),
+    ("mgwfbp_serve_latency_p50_seconds", "gauge",
+     "predict request latency p50 over the recent-request window"),
+    ("mgwfbp_serve_latency_p95_seconds", "gauge",
+     "predict request latency p95 over the recent-request window"),
+    ("mgwfbp_serve_latency_p99_seconds", "gauge",
+     "predict request latency p99 over the recent-request window"),
+    ("mgwfbp_shadow_eval_loss", "gauge",
+     "latest shadow-eval loss on the held-out stream"),
+    ("mgwfbp_shadow_eval_delta", "gauge",
+     "latest shadow-eval loss minus training loss (served-vs-training)"),
     # fleet fan-in synthesis (rendered only by telemetry/fleet.py's
     # /fleet/metrics, never by a per-process endpoint — registered here
     # so the fleet exposition flows through the same single registry)
@@ -289,6 +314,8 @@ EVENT_COUNTERS: dict[str, str] = {
     "resume": "mgwfbp_resumes_total",
     "profile": "mgwfbp_profile_windows_total",
     "postmortem": "mgwfbp_postmortems_total",
+    "reload": "mgwfbp_serve_reloads_total",
+    "shadow_eval": "mgwfbp_shadow_evals_total",
 }
 
 

@@ -32,7 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from mgwfbp_tpu import models as zoo
-from mgwfbp_tpu.checkpoint import Checkpointer, Snapshot
+from mgwfbp_tpu.checkpoint import (
+    Checkpointer,
+    CheckpointRestoreError,
+    Snapshot,
+)
 from mgwfbp_tpu.config import TrainConfig
 from mgwfbp_tpu.data import ShardInfo, data_prepare
 from mgwfbp_tpu.optim import make_optimizer
@@ -780,6 +784,68 @@ class Trainer:
         except Exception as e:  # noqa: BLE001 — disk full / fs gone
             self.log.warning("telemetry write failed (%s); disabling", e)
             self.telemetry = None
+
+    def _start_serve_plane(self) -> None:
+        """In-process serving plane (``--serve-shadow``, ISSUE 19): a
+        ServingModel + reload watcher + /predict dispatcher riding THIS
+        process's metrics server, hot-reloading every checkpoint the run
+        commits — without touching the step loop (the reload path is
+        device_put + jit only, no collectives, so its threads coexist
+        with the step loop's owning-thread discipline). Single-process
+        only; a multi-host group serves from standalone replicas
+        (``supervise --serve-replicas``) instead."""
+        if (
+            not self.config.serve_shadow
+            or getattr(self, "_serve_plane", None) is not None
+        ):
+            return
+        if coord.process_count() != 1:
+            self.log.warning(
+                "--serve-shadow is single-process only (standalone "
+                "replicas serve multi-host runs); serving disabled"
+            )
+            return
+        if self.checkpointer is None or self.telemetry is None:
+            self.log.warning(
+                "--serve-shadow needs --checkpoint-dir and telemetry; "
+                "serving disabled"
+            )
+            return
+        from mgwfbp_tpu.serving.model import ServingModel
+        from mgwfbp_tpu.serving.plane import ServePlane
+
+        module, meta = zoo.create_model(
+            self.config.dnn, dataset=self.config.dataset
+        )
+        try:
+            serving_model = ServingModel(module, meta, mesh=self.mesh)
+        except ValueError as e:
+            self.log.warning("--serve-shadow: %s; serving disabled", e)
+            return
+        agg = getattr(self, "_metrics_agg", None)
+        train_loss_fn = None
+        if agg is not None:
+            def train_loss_fn():
+                v = agg.values().get("mgwfbp_health_loss")
+                return float(v) if v is not None else None
+        self._serve_plane = ServePlane(
+            serving_model,
+            os.path.join(
+                self.config.checkpoint_dir, self.config.tag()
+            ),
+            emit=lambda ev, f: self._emit_event(ev, **f),
+            server=getattr(self, "_metrics_server", None),
+            shadow=True,
+            train_loss_fn=train_loss_fn,
+        )
+        self._serve_plane.start()
+        self.log.info(
+            "serving plane up: hot-reloading committed checkpoints, "
+            "shadow-eval on, /predict %s (slot %d)",
+            "attached" if getattr(self, "_metrics_server", None)
+            is not None else "unattached (no metrics port)",
+            serving_model.max_batch,
+        )
 
     def _layer_specs(self) -> list:
         """Arrival-ordered LayerSpecs of the live reducer's layer set
@@ -3422,10 +3488,60 @@ class Trainer:
         """The canonical replicated params for host/eval consumers: the
         live tree, or the cross-step carry gathered back into it (a
         collective all-gather on a multi-host mesh — the one place the
-        replicated view is genuinely needed)."""
+        replicated view is genuinely needed). When the CURRENT iteration
+        already committed a shard-native checkpoint, the gathered view is
+        sitting on disk — read it off the manifest instead of issuing the
+        collective (ROADMAP shard-native follow-up (b); pinned bitwise
+        against the gathered path in tests/test_serving.py)."""
         if not self._cross_step:
             return self.state.params
+        params = self._manifest_eval_params()
+        if params is not None:
+            self._eval_params_source = "manifest"
+            return params
+        self._eval_params_source = "gather"
         return self._gathered_params(self.state.params)
+
+    def _manifest_eval_params(self):
+        """Replicated params rebuilt leaf-by-leaf from the committed
+        shard-native checkpoint of the current iteration, or None when no
+        such checkpoint exists (mid-cadence, async commit still pending,
+        orbax format) — the caller falls back to the gather. Single
+        process only: the gather it replaces is a collective, so skipping
+        it must be group-uniform, and one process cannot know its
+        siblings see the same committed manifest."""
+        if coord.process_count() != 1 or self.checkpointer is None:
+            return None
+        step = int(self.iteration)
+        try:
+            if self.checkpointer.entry_format(step) != "sharded":
+                return None
+            src = self.checkpointer.open_sharded(step)
+        except CheckpointRestoreError:
+            return None
+        if src.section_kind("params") == "none":
+            return None
+        template = jax.tree_util.tree_leaves(self._params_template)
+        docs = src.section_docs("params")
+        if len(docs) != len(template):
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(self.mesh, PartitionSpec())
+        leaves = []
+        for j, ref in enumerate(template):
+            doc = docs[j]
+            if tuple(doc.get("shape", ())) != tuple(ref.shape) or (
+                jnp.dtype(doc.get("dtype", "float32"))
+                != jnp.dtype(ref.dtype)
+            ):
+                return None
+            leaves.append(
+                jax.device_put(src.read_leaf("params", j), sharding)
+            )
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._params_template), leaves
+        )
 
     def _eval_state(self):
         """State view eval steps consume: replicated params (gathered from
@@ -4024,6 +4140,12 @@ class Trainer:
         return manifest, files
 
     def close(self) -> None:
+        plane = getattr(self, "_serve_plane", None)
+        if plane is not None:
+            # first: its watcher/dispatcher threads emit telemetry and
+            # read the checkpoint dir, both of which close below
+            plane.close()
+            self._serve_plane = None
         if self.checkpointer is not None:
             if coord.process_count() == 1:
                 # land the in-flight async save's commit AND its
@@ -4605,6 +4727,9 @@ class Trainer:
                 self._watchdog = wd if wd.enabled else None
                 # SIGTERM/SIGINT -> graceful drain for the whole fit
                 self._arm_signals()
+                # --serve-shadow: the in-process serving plane rides the
+                # whole fit (hot-reloads land as checkpoints commit)
+                self._start_serve_plane()
                 if cfg.autotune and self.autotune_report is None:
                     # closed-loop tuning phase: the first few real steps
                     # race candidate schedules (cache hit skips the race)

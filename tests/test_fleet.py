@@ -196,11 +196,12 @@ def test_write_fleet_sd_http_sd_format(tmp_path):
         path, {0: ("127.0.0.1", 9100), 1: ("127.0.0.1", 45001)},
     )
     assert json.load(open(path)) == doc
+    # targets not named in `roles` default to the training role
     assert doc == [
         {"targets": ["127.0.0.1:9100"],
-         "labels": {"job": "mgwfbp", "process": "0"}},
+         "labels": {"job": "mgwfbp", "process": "0", "role": "train"}},
         {"targets": ["127.0.0.1:45001"],
-         "labels": {"job": "mgwfbp", "process": "1"}},
+         "labels": {"job": "mgwfbp", "process": "1", "role": "train"}},
     ]
 
 

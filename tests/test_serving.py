@@ -1,0 +1,502 @@
+"""Serving plane (ISSUE 19): hot-reload sharded inference + batched
+/predict riding the training runtime.
+
+Pins, in-process on the CPU-8 mesh:
+
+  * the e2e lifecycle: a --serve-shadow training run commits shard-native
+    steps, the reload watcher hot-swaps them (reload events, served_step
+    advancing from a MID-EPOCH commit to the newest), concurrent HTTP
+    POST /predict answers bitwise-match the model plane's own
+    ``run_padded`` on the same snapshot, and the serving forward's jaxpr
+    carries ZERO collectives (the no-sync contract that lets the serving
+    threads coexist with the step loop);
+  * the manifest-addressed partial eval (satellite 1): ``_eval_params``
+    reads single leaves off the committed shard manifest instead of
+    all-gathering the live cross-step carry, bitwise vs the gathered
+    path;
+  * the concurrency hammer: client threads against the dispatcher while
+    the main thread hot-swaps checkpoints — every response carries a
+    consistent served_step whose outputs bitwise-match that exact
+    checkpoint (immutable-snapshot swap = no torn params), plus the
+    distilled THR twin of the dispatcher-carry race the checker catches
+    when the documented pin is removed;
+  * the role-aware metrics port/port-file namespace (satellite 6): serve
+    replicas band-offset away from training children, supervisor port
+    files and fleet sidecar labels keeping the roles apart;
+  * the standalone replica CLI (`python -m mgwfbp_tpu.serving`) serving
+    /predict from a committed checkpoint directory end to end.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from mgwfbp_tpu import models
+from mgwfbp_tpu.config import make_config
+from mgwfbp_tpu.parallel.mesh import MeshSpec, make_mesh
+from mgwfbp_tpu.serving.model import ServingModel, committed_sharded_steps
+from mgwfbp_tpu.serving.service import PredictService
+from mgwfbp_tpu.train.trainer import Trainer
+
+
+def _mk_trainer(root, world: int = 4, **overrides):
+    kw = dict(
+        batch_size=4, max_epochs=2, logdir="",
+        checkpoint_dir=os.path.join(str(root), "ckpt"), seed=3,
+        num_batches_per_epoch=4, ckpt_every_steps=2, comm_op="rs_fwd_ag",
+    )
+    kw.update(overrides)
+    cfg = make_config("mnistnet", **kw)
+    return cfg, Trainer(
+        cfg, synthetic_data=True, profile_backward=False,
+        mesh=make_mesh(MeshSpec(data=world), devices=jax.devices()[:world]),
+    )
+
+
+def _post(port: int, doc: dict, timeout_s: float = 10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _events(logdir: str) -> list[dict]:
+    from mgwfbp_tpu.telemetry import read_event_set
+
+    (path,) = glob.glob(os.path.join(logdir, "*", "telemetry.jsonl"))
+    return read_event_set(path)
+
+
+@pytest.fixture(scope="module")
+def ckpt_run(tmp_path_factory):
+    """One plain (serving-off) training run's committed shard-native
+    checkpoint directory: steps 2,4,6,8 — 2 and 6 are MID-EPOCH commits
+    (4 steps/epoch)."""
+    root = tmp_path_factory.mktemp("serving_ckpts")
+    cfg, t = _mk_trainer(root)
+    t.fit(2)
+    t.close()
+    tag_dir = os.path.join(cfg.checkpoint_dir, cfg.tag())
+    steps = committed_sharded_steps(tag_dir)
+    assert len(steps) >= 3, f"expected several committed steps, got {steps}"
+    _, meta = models.create_model("mnistnet")
+    return tag_dir, meta
+
+
+# ---------------------------------------------------------------------------
+# e2e: --serve-shadow riding a real training run
+# ---------------------------------------------------------------------------
+
+
+def test_serve_shadow_e2e_hot_reload_bitwise(tmp_path):
+    cfg, t = _mk_trainer(
+        tmp_path, logdir=str(tmp_path / "logs"), telemetry=True,
+        metrics_port=0, serve_shadow=True,
+    )
+    tag_dir = os.path.join(cfg.checkpoint_dir, cfg.tag())
+    try:
+        t.fit(2)
+        plane = getattr(t, "_serve_plane", None)
+        assert plane is not None, "--serve-shadow never started the plane"
+        server = t._metrics_server
+        assert server is not None
+
+        # catch up to the newest committed step (the async writer may
+        # commit the last save just after fit returns)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            steps = committed_sharded_steps(tag_dir)
+            if steps and plane.model.served_step() == steps[-1]:
+                break
+            plane.poll_now()
+            time.sleep(0.05)
+        steps = committed_sharded_steps(tag_dir)
+        assert steps and plane.model.served_step() == steps[-1], (
+            steps, plane.model.served_step(),
+        )
+
+        # concurrent POST /predict: every response 200, uniform
+        # served_step, outputs BITWISE equal to the model plane's own
+        # run_padded on the same snapshot (JSON's repr round-trip is
+        # exact for float32-via-float64)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(
+            (3,) + tuple(plane.model.meta.input_shape)
+        ).astype(np.float32)
+        direct, direct_step = plane.model.run_padded(x)
+        results: list = []
+
+        def client():
+            results.append(_post(server.port, {"inputs": x.tolist()}))
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert len(results) == 4
+        for code, doc in results:
+            assert code == 200, doc
+            assert int(doc["served_step"]) == direct_step == steps[-1]
+            got = np.asarray(doc["outputs"], dtype=np.float32)
+            np.testing.assert_array_equal(got, direct)
+
+        # zero-sync pin: the serving forward carries NO collectives —
+        # any thread may run it without touching the step loop's
+        # lockstep protocol
+        snap = plane.model.snapshot()
+        xd = np.zeros(
+            (plane.model.max_batch,) + tuple(plane.model.meta.input_shape),
+            plane.model.input_np_dtype,
+        )
+        jaxpr = str(jax.make_jaxpr(plane.model._forward)(
+            snap.params, snap.batch_stats, xd
+        ))
+        for tok in ("psum", "all_gather", "all_reduce", "ppermute",
+                    "all_to_all"):
+            assert tok not in jaxpr, f"collective {tok} on the serve path"
+
+        # deterministic served-step advance off a MID-EPOCH commit: park
+        # the model on the first commit (step 2, mid-epoch at 4
+        # steps/epoch), then one watcher poll must hot-reload to the
+        # newest — emitting the reload event and the shadow-eval score
+        plane.watcher.close()  # stop the background poller (no race)
+        plane.model.load_step(tag_dir, steps[0])
+        assert steps[0] % 4 != 0, f"step {steps[0]} is not mid-epoch"
+        assert plane.model.served_step() == steps[0]
+        advanced = plane.watcher.poll_once()
+        assert advanced == steps[-1]
+        assert plane.model.served_step() == steps[-1]
+    finally:
+        t.close()
+
+    recs = _events(str(tmp_path / "logs"))
+    from mgwfbp_tpu.telemetry import events_of
+
+    reloads = events_of(recs, "reload")
+    assert reloads, "no reload events in the stream"
+    assert [int(r["step"]) for r in reloads][-1] == steps[-1]
+    assert all(float(r["lag_s"]) >= 0 for r in reloads)
+    assert all(float(r["duration_s"]) > 0 for r in reloads)
+    shadows = events_of(recs, "shadow_eval")
+    assert shadows, "no shadow_eval events in the stream"
+    assert int(shadows[-1]["step"]) == steps[-1]
+    assert np.isfinite(float(shadows[-1]["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: manifest-addressed partial eval, bitwise vs the gather path
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_eval_params_bitwise(tmp_path, monkeypatch):
+    _, t = _mk_trainer(tmp_path)
+    try:
+        t.fit(1)
+        # wait out the async writer: the manifest path only engages once
+        # the CURRENT iteration's commit is visible (a pending commit
+        # must fall back to the gather, never read a torn directory)
+        deadline = time.time() + 30
+        while (
+            time.time() < deadline
+            and t.checkpointer.entry_format(int(t.iteration)) != "sharded"
+        ):
+            time.sleep(0.05)
+        assert t.checkpointer.entry_format(int(t.iteration)) == "sharded"
+
+        p_manifest = t._eval_params()
+        assert t._eval_params_source == "manifest"
+        monkeypatch.setattr(t, "_manifest_eval_params", lambda: None)
+        p_gather = t._eval_params()
+        assert t._eval_params_source == "gather"
+        lm = jax.tree_util.tree_leaves(p_manifest)
+        lg = jax.tree_util.tree_leaves(p_gather)
+        assert len(lm) == len(lg) and lm
+        for a, b in zip(lm, lg):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: concurrency hammer — no torn params across hot swaps
+# ---------------------------------------------------------------------------
+
+
+def test_predict_hammer_under_hot_reload(ckpt_run):
+    tag_dir, _ = ckpt_run
+    module, meta = models.create_model("mnistnet")
+    model = ServingModel(module, meta, mesh=make_mesh(MeshSpec(data=8)),
+                         max_batch=8)
+    steps = committed_sharded_steps(tag_dir)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal(
+        (3,) + tuple(meta.input_shape)
+    ).astype(np.float32)
+    expected = {}
+    for s in steps:
+        model.load_step(tag_dir, s)
+        out, got = model.run_padded(x)
+        assert got == s
+        expected[s] = out
+    # distinct checkpoints must answer distinctly, or the torn-params
+    # check below would be vacuous
+    assert not np.array_equal(expected[steps[0]], expected[steps[-1]])
+
+    service = PredictService(model, flush_ms=5.0)
+    service.start()
+    errors: list = []
+    seen: set = set()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            code, doc = service.handle(x)
+            if code != 200:
+                errors.append((code, doc))
+                return
+            s = int(doc["served_step"])
+            if s not in expected:
+                errors.append(("unknown served_step", s))
+                return
+            got = np.asarray(doc["outputs"], dtype=np.float32)
+            if not np.array_equal(got, expected[s]):
+                errors.append(("torn/mismatched outputs for step", s))
+                return
+            seen.add(s)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    try:
+        for th in threads:
+            th.start()
+        t_end = time.monotonic() + 1.5
+        i = 0
+        while time.monotonic() < t_end:
+            model.load_step(tag_dir, steps[i % len(steps)])
+            i += 1
+            time.sleep(0.03)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=60)
+        service.close()
+    assert errors == [], errors
+    assert len(seen) >= 2, (
+        f"hammer never observed a swap (served steps seen: {seen})"
+    )
+
+
+def test_thr_twin_unpinned_dispatcher_carry_is_flagged():
+    """The distilled race the shipped pin documents: a dispatcher-thread
+    field also written from close() with no common lock. Without the
+    `# graft: thread-safe` pin the THR pass must flag it."""
+    from mgwfbp_tpu.analysis.race_check import check_sources
+
+    src = (
+        "import queue\n"
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Dispatcher:\n"
+        "    def __init__(self):\n"
+        "        self._queue = queue.Queue()\n"
+        "        self._carry = None\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "\n"
+        "    def _run(self):\n"
+        "        while True:\n"
+        "            self._carry = self._queue.get()\n"
+        "\n"
+        "    def close(self):\n"
+        "        self._carry = None\n"
+    )
+    findings = check_sources({"twin.py": src})
+    assert any(
+        f.rule_id == "THR001" and "_carry" in f.message for f in findings
+    ), [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# service-level request validation
+# ---------------------------------------------------------------------------
+
+
+def test_predict_service_validation(ckpt_run):
+    tag_dir, _ = ckpt_run
+    module, meta = models.create_model("mnistnet")
+    model = ServingModel(module, meta, mesh=make_mesh(MeshSpec(data=8)),
+                         max_batch=4)
+    service = PredictService(model)
+    code, doc = service.handle([[0.0]])
+    assert code == 503, doc  # nothing served yet
+    model.load_step(tag_dir, committed_sharded_steps(tag_dir)[-1])
+    code, doc = service.handle("garbage")
+    assert code == 400 and "coercible" in doc["error"]
+    code, doc = service.handle(
+        np.zeros((5,) + tuple(meta.input_shape), np.float32)
+    )
+    assert code == 400 and "slot" in doc["error"]  # exceeds max_batch
+    code, doc = service.handle(np.zeros((2, 3, 3, 1), np.float32))
+    assert code == 400, doc  # wrong example shape
+    service.start()
+    try:
+        # a single example auto-batches to n=1
+        code, doc = service.handle(
+            np.zeros(tuple(meta.input_shape), np.float32)
+        )
+        assert code == 200 and len(doc["outputs"]) == 1, doc
+    finally:
+        service.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite 6: role-aware metrics port / port-file namespace
+# ---------------------------------------------------------------------------
+
+
+def test_role_aware_metrics_ports(monkeypatch):
+    from mgwfbp_tpu.telemetry.serve import (
+        resolve_metrics_port,
+        serve_port_offset,
+    )
+
+    assert resolve_metrics_port(9100, 3) == 9103
+    assert resolve_metrics_port(9100, 0, role="serve") == 9200
+    assert resolve_metrics_port(9100, 2, role="serve") == 9202
+    assert resolve_metrics_port(0, 5, role="serve") == 0  # ephemeral
+    with pytest.raises(ValueError):
+        resolve_metrics_port(9100, 0, role="coordinator")
+    # the serve band never collides with any training child's base+i
+    # port for groups up to the offset width
+    train = {resolve_metrics_port(9100, i) for i in range(100)}
+    serve = {
+        resolve_metrics_port(9100, i, role="serve") for i in range(100)
+    }
+    assert not train & serve
+    monkeypatch.setenv("MGWFBP_SERVE_PORT_OFFSET", "500")
+    assert serve_port_offset() == 500
+    assert resolve_metrics_port(9100, 1, role="serve") == 9601
+    monkeypatch.setenv("MGWFBP_SERVE_PORT_OFFSET", "bogus")
+    assert serve_port_offset() == 100  # fall back, never crash
+
+
+def test_supervisor_serve_replica_namespace(tmp_path):
+    from mgwfbp_tpu.runtime.supervisor import Supervisor
+    from mgwfbp_tpu.telemetry.fleet import write_fleet_sd
+
+    with pytest.raises(ValueError):
+        Supervisor(["true"], 1, serve_replicas=1)  # needs a serve_cmd
+    with pytest.raises(ValueError):
+        Supervisor(["true"], 1, serve_replicas=-1, serve_cmd=["true"])
+    sup = Supervisor(
+        ["true"], 2, serve_replicas=2, serve_cmd=["true"],
+        log_dir=str(tmp_path),
+        env={
+            "MGWFBP_METRICS_PORT": "9100",
+            "MGWFBP_COORDINATOR": "127.0.0.1:1",
+            "MGWFBP_PROCESS_ID": "0",
+            "MGWFBP_NUM_PROCESSES": "2",
+        },
+    )
+    # role-aware port-file namespace: replica i never clobbers child i
+    assert sup._port_file(0) != sup._port_file(0, role="serve")
+    assert os.path.basename(
+        sup._port_file(1, role="serve")
+    ) == "metrics_port.serve1.json"
+    # a serve replica gets NO coordinator contract (stripped even when
+    # inherited), its replica index, and its role-aware port file
+    env = sup._serve_env(0)
+    assert env["MGWFBP_SERVE_REPLICA"] == "0"
+    for k in ("MGWFBP_COORDINATOR", "MGWFBP_PROCESS_ID",
+              "MGWFBP_NUM_PROCESSES"):
+        assert k not in env
+    assert env["MGWFBP_METRICS_PORT_FILE"].endswith(
+        "metrics_port.serve0.json"
+    )
+    # target map: training children on base+i, serve replicas str-keyed
+    # on the role-offset band; a written port file overrides the guess
+    targets = sup._child_targets()
+    assert targets[0] == ("127.0.0.1", 9100)
+    assert targets[1] == ("127.0.0.1", 9101)
+    assert targets["serve0"] == ("127.0.0.1", 9200)
+    assert targets["serve1"] == ("127.0.0.1", 9201)
+    with open(sup._port_file(1, role="serve"), "w") as f:
+        json.dump({"host": "127.0.0.1", "port": 45678}, f)
+    assert sup._child_targets()["serve1"] == ("127.0.0.1", 45678)
+    # the fleet sidecar labels each target with its role
+    doc = write_fleet_sd(
+        str(tmp_path / "fleet.json"), sup._child_targets(),
+        roles={k: sup._target_role(k) for k in sup._child_targets()},
+    )
+    roles = {g["labels"]["process"]: g["labels"]["role"] for g in doc}
+    assert roles["0"] == "train" and roles["1"] == "train"
+    assert roles["serve0"] == "serve" and roles["serve1"] == "serve"
+    # serving meta rides /fleet/status
+    assert sup._fleet_meta()["serving"] == {"replicas": 2, "alive": 0}
+
+
+# ---------------------------------------------------------------------------
+# standalone replica CLI
+# ---------------------------------------------------------------------------
+
+
+def test_standalone_cli_serves_predict(ckpt_run, tmp_path, monkeypatch):
+    from mgwfbp_tpu.serving.__main__ import main
+
+    tag_dir, meta = ckpt_run
+    port_file = tmp_path / "serve_port.json"
+    monkeypatch.setenv("MGWFBP_METRICS_PORT_FILE", str(port_file))
+    rc_box: dict = {}
+    th = threading.Thread(
+        target=lambda: rc_box.update(rc=main([
+            "--dnn", "mnistnet", "--checkpoint-dir", tag_dir,
+            "--metrics-port", "0", "--poll-s", "0.05",
+            "--max-seconds", "20",
+        ])),
+        daemon=True,
+    )
+    th.start()
+    deadline = time.time() + 15
+    port = None
+    while time.time() < deadline and port is None:
+        try:
+            doc = json.loads(port_file.read_text())
+            assert doc["role"] == "serve", doc
+            port = int(doc["port"])
+        except (OSError, ValueError, KeyError):
+            time.sleep(0.05)
+    assert port, "replica never wrote its role-aware port file"
+    x = np.zeros((2,) + tuple(meta.input_shape), np.float32)
+    resp = None
+    while time.time() < deadline and resp is None:
+        try:
+            code, doc = _post(port, {"inputs": x.tolist()}, timeout_s=5.0)
+        except Exception:  # noqa: BLE001 — server still binding
+            time.sleep(0.1)
+            continue
+        if code == 200:
+            resp = doc
+        else:
+            time.sleep(0.1)
+    assert resp is not None, "standalone replica never answered /predict"
+    assert int(resp["served_step"]) == committed_sharded_steps(tag_dir)[-1]
+    assert len(resp["outputs"]) == 2
+    assert len(resp["outputs"][0]) == meta.num_classes
+    th.join(timeout=60)
+    assert rc_box.get("rc") == 0
