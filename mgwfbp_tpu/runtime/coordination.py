@@ -33,6 +33,12 @@ work, so these are safe to leave in single-host hot paths.
 Payloads ride float32 on the device (jax x64 is off): exact for flags,
 counts below 2**24, and wall-clock seconds — the only things routed
 through here.
+
+Every multi-process call is BOUNDED: the device-transport primitives run
+under `MGWFBP_COORD_TIMEOUT_S` (default = the barrier timeout) and the
+barrier under `MGWFBP_BARRIER_TIMEOUT_S`; a miss or transport error
+raises `CoordinationTimeout` so a dead/wedged peer surfaces as a clean
+restart-friendly exit instead of an indefinite hang.
 """
 
 from __future__ import annotations
@@ -48,7 +54,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mgwfbp_tpu.utils.platform import get_shard_map, run_with_deadline
+from mgwfbp_tpu.utils.platform import (
+    env_float,
+    get_shard_map,
+    run_with_deadline,
+)
 
 # 1-axis mesh over every global device, used only by these primitives
 COORD_AXIS = "coord"
@@ -60,6 +70,40 @@ COORD_SCOPE = "runtime_coord"
 # wedged process — fail so the supervisor can tear down and resubmit
 BARRIER_TIMEOUT_ENV = "MGWFBP_BARRIER_TIMEOUT_S"
 DEFAULT_BARRIER_TIMEOUT_S = 600.0
+
+# real-deadline contract for the DEVICE-transport primitives (ISSUE 20):
+# agree_any / agree_all / broadcast_flag / gather_* / agree_uniform /
+# all_argmin block inside a gloo/ICI collective when a peer is dead or
+# wedged — exactly the hang the barrier's timeout already refuses. The
+# same deadline bounds them all; a miss raises CoordinationTimeout so
+# the trainer can convert an opaque distributed hang into a clean
+# rc-75-style exit the supervisor's healer understands.
+COORD_TIMEOUT_ENV = "MGWFBP_COORD_TIMEOUT_S"
+
+
+class CoordinationTimeout(RuntimeError):
+    """A lockstep group operation did not complete within its real
+    deadline (or its transport failed outright): a peer process is dead
+    or wedged, so the collective can NEVER complete. The process is
+    tainted (an abandoned worker thread may hold transport locks) — the
+    caller must exit promptly and restart-friendly; train_cli converts
+    this to rc 75 (drain-less: no checkpoint barrier can complete
+    either) so the supervisor heals the group from the last committed
+    step."""
+
+    def __init__(self, op: str, timeout_s: float, detail: str = ""):
+        super().__init__(
+            f"coordination op {op!r} did not complete within "
+            f"{timeout_s:.0f}s{f' ({detail})' if detail else ''}; a peer "
+            "process is dead or wedged — exiting restart-friendly so the "
+            "supervisor can heal the group"
+        )
+        self.op = op
+        self.timeout_s = timeout_s
+
+
+def _coord_timeout_s() -> float:
+    return env_float(COORD_TIMEOUT_ENV, DEFAULT_BARRIER_TIMEOUT_S)
 
 
 # ---------------------------------------------------------------------------
@@ -138,7 +182,9 @@ def _reduce_prog(kind: str):
     )
 
 
-def _device_reduce(vals: Sequence[float], kind: str) -> np.ndarray:
+def _device_reduce(
+    vals: Sequence[float], kind: str, op: str = "device_reduce",
+) -> np.ndarray:
     """Reduce a per-process float vector across ALL processes ("sum" or
     "max"); returns the identical reduced vector on every process.
 
@@ -147,14 +193,34 @@ def _device_reduce(vals: Sequence[float], kind: str) -> np.ndarray:
     never double-counts a process. Works single-process too (the tests
     exercise the device path directly); the public primitives
     short-circuit before reaching here when there is nothing to agree.
-    """
+
+    Multi-process, the blocking collective runs under the same real
+    deadline the barrier already has (MGWFBP_COORD_TIMEOUT_S, default
+    the barrier default): a dead or wedged peer means the rendezvous can
+    never complete, and a deadline miss — or the transport erroring
+    outright (a peer's death can also surface as a connection reset from
+    the collective instead of a hang) — raises CoordinationTimeout
+    naming `op` so the caller exits restart-friendly instead of hanging
+    until the supervisor's hard teardown."""
     row = np.asarray(vals, np.float32).reshape(-1)
     fill = 0.0 if kind == "sum" else -np.inf
     local = np.full((jax.local_device_count(), row.size), fill, np.float32)
     local[0] = row
     sharding = NamedSharding(_coord_mesh(), P(COORD_AXIS))
     garr = jax.make_array_from_process_local_data(sharding, local)
-    return np.asarray(_reduce_prog(kind)(garr))
+    if jax.process_count() == 1:
+        # nothing to rendezvous with: no deadline thread per call on the
+        # single-host hot path (and the direct-call unit tests)
+        return np.asarray(_reduce_prog(kind)(garr))
+    timeout_s = _coord_timeout_s()
+    try:
+        return run_with_deadline(
+            lambda: np.asarray(_reduce_prog(kind)(garr)),
+            timeout_s, what=f"coordination op {op!r}",
+        )
+    except Exception as e:  # noqa: BLE001 — deadline miss and transport
+        # failure are ONE structured surface: both mean a peer is gone
+        raise CoordinationTimeout(op, timeout_s, detail=str(e)) from e
 
 
 # ---------------------------------------------------------------------------
@@ -167,7 +233,10 @@ def agree_any(flag: bool) -> bool:
     signaled host drains the whole group)."""
     if process_count() == 1:
         return bool(flag)
-    return bool(_device_reduce([1.0 if flag else 0.0], "sum")[0] > 0.0)
+    return bool(
+        _device_reduce([1.0 if flag else 0.0], "sum", op="agree_any")[0]
+        > 0.0
+    )
 
 
 @group_op
@@ -177,7 +246,9 @@ def agree_all(flag: bool) -> bool:
     the entry)."""
     if process_count() == 1:
         return bool(flag)
-    total = _device_reduce([1.0 if flag else 0.0], "sum")[0]
+    total = _device_reduce(
+        [1.0 if flag else 0.0], "sum", op="agree_all",
+    )[0]
     return bool(total >= float(process_count()))
 
 
@@ -189,7 +260,7 @@ def broadcast_flag(value: float, source: int = 0) -> float:
     if process_count() == 1:
         return float(value)
     contrib = float(value) if process_index() == source else 0.0
-    return float(_device_reduce([contrib], "sum")[0])
+    return float(_device_reduce([contrib], "sum", op="broadcast_flag")[0])
 
 
 @group_op
@@ -203,7 +274,9 @@ def gather_values(value: float) -> list[float]:
         return [float(value)]
     row = [0.0] * process_count()
     row[process_index()] = float(value)
-    return [float(t) for t in _device_reduce(row, "sum")]
+    return [
+        float(t) for t in _device_reduce(row, "sum", op="gather_values")
+    ]
 
 
 @group_op
@@ -225,7 +298,7 @@ def gather_vectors(values: Sequence[float]) -> list[list[float]]:
     flat = [0.0] * (n * k)
     start = process_index() * k
     flat[start:start + k] = row
-    reduced = _device_reduce(flat, "sum")
+    reduced = _device_reduce(flat, "sum", op="gather_vectors")
     return [
         [float(t) for t in reduced[i * k:(i + 1) * k]] for i in range(n)
     ]
@@ -242,8 +315,8 @@ def agree_uniform(value: float) -> bool:
     if process_count() == 1:
         return True
     v = float(value)
-    mx = float(_device_reduce([v], "max")[0])
-    mn = -float(_device_reduce([-v], "max")[0])
+    mx = float(_device_reduce([v], "max", op="agree_uniform")[0])
+    mn = -float(_device_reduce([-v], "max", op="agree_uniform")[0])
     return mx == mn
 
 
@@ -267,7 +340,9 @@ def all_argmin(values: Sequence[Optional[float]]) -> tuple[int, list[float]]:
     if not vals:
         raise ValueError("all_argmin: empty candidate list")
     if process_count() > 1:
-        vals = [float(t) for t in _device_reduce(vals, "max")]
+        vals = [
+            float(t) for t in _device_reduce(vals, "max", op="all_argmin")
+        ]
     return int(np.argmin(vals)), vals
 
 
@@ -284,8 +359,9 @@ def barrier(name: str, timeout_s: Optional[float] = None) -> None:
     Uses the jax.distributed coordination-service barrier (timeout
     enforced server-side); a missing client degrades to
     `multihost_utils.sync_global_devices` under a thread deadline. A
-    timeout raises RuntimeError — the caller should treat the process
-    group as broken and exit so the supervisor can resubmit it.
+    timeout raises CoordinationTimeout (a RuntimeError) — the caller
+    should treat the process group as broken and exit so the supervisor
+    can heal it.
     """
     if process_count() == 1:
         return
@@ -321,8 +397,6 @@ def barrier(name: str, timeout_s: Optional[float] = None) -> None:
                 timeout_s, what=f"barrier {name!r}",
             )
     except Exception as e:  # noqa: BLE001 — uniform failure surface
-        raise RuntimeError(
-            f"coordination barrier {name!r} failed after {timeout_s:.0f}s "
-            f"({e}); a peer process is dead or wedged — exiting so the "
-            "supervisor can tear down and resubmit the group"
+        raise CoordinationTimeout(
+            f"barrier:{name}", timeout_s, detail=str(e)
         ) from e

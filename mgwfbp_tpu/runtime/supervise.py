@@ -9,8 +9,12 @@ Everything after ``--`` goes to `mgwfbp_tpu.train_cli` verbatim; the
 supervisor exports MGWFBP_COORDINATOR / MGWFBP_NUM_PROCESSES /
 MGWFBP_PROCESS_ID per child. Exit-code policy (README "Multi-host
 runtime"): rc 75 resubmits the whole group with bounded exponential
-backoff, rc 86 (watchdog abort) stops and points at the stack dumps,
-any other failure tears down the stragglers and propagates.
+backoff, rc 86 (watchdog abort) stops and points at the stack dumps.
+Hard failures SELF-HEAL by default (ISSUE 20): crashes relaunch at the
+same world, OOM-style SIGKILLs shrink to the survivor count (elastic
+resume), wedged children are detected by the liveness monitor and the
+group is drained and relaunched — all under per-class budgets;
+``--no-heal`` restores the old teardown-and-propagate policy.
 """
 
 from __future__ import annotations
@@ -90,6 +94,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="arguments for the serving CLI, one shell-quoted "
                         "string (e.g. --serve-args '--dnn lenet "
                         "--checkpoint-dir ckpts --shadow')")
+    p.add_argument("--no-heal", dest="heal", action="store_false",
+                   default=True,
+                   help="disable self-healing: any hard child failure "
+                        "(crash/OOM/wedge) tears the group down and "
+                        "propagates, the pre-ISSUE-20 policy")
+    p.add_argument("--heal-max-restarts", dest="heal_max_restarts",
+                   type=int, default=2,
+                   help="per-failure-class healing budget (crash, "
+                        "oom_kill, wedge, ... each get this many "
+                        "relaunches before the supervisor gives up)")
+    p.add_argument("--liveness-grace", dest="liveness_grace", type=float,
+                   default=None,
+                   help="seconds a child's /status step may stay frozen "
+                        "(or its endpoint unreachable) before it is "
+                        "declared wedged and the group is healed "
+                        "(default: MGWFBP_LIVENESS_GRACE_S or 120)")
+    p.add_argument("--serve-max-restarts", dest="serve_max_restarts",
+                   type=int, default=3,
+                   help="per-replica respawn budget for crashed serve "
+                        "replicas (backoff-spaced; budget spent = the "
+                        "replica stays down)")
     p.add_argument("train_args", nargs=argparse.REMAINDER,
                    help="arguments for mgwfbp_tpu.train_cli (prefix "
                         "with --)")
@@ -119,6 +144,10 @@ def main(argv: Optional[list[str]] = None) -> int:
             default_serve_cmd(shlex.split(args.serve_args or ""))
             if args.serve_replicas else None
         ),
+        heal=args.heal,
+        heal_max_restarts=args.heal_max_restarts,
+        liveness_grace_s=args.liveness_grace,
+        serve_max_restarts=args.serve_max_restarts,
     )
     return sup.run()
 

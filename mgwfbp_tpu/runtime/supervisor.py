@@ -16,8 +16,26 @@ under the cluster's scheduler and only the rc contract below applies):
                  process faulthandler-dumped every thread's stack first.
                  Restarting a wedged grant loops forever, so STOP and
                  surface where the dumps are.
-  other  (any)   a real failure: tear down the stragglers (SIGTERM,
+  other  (any)   a HARD failure. With healing on (the default, ISSUE
+                 20): classify it (classify_rc — crash / oom_kill /
+                 term), SIGTERM the survivors so they drain through the
+                 agreed-preempt path (or their coordination deadline),
+                 then relaunch — same world when the slot looks
+                 recoverable, SHRUNK to the survivor count for an
+                 OOM-style SIGKILL (elastic resume re-shards off the
+                 last committed shard-native step) — under per-class
+                 restart budgets and a same-step crash-loop detector.
+                 With heal=False: tear down the stragglers (SIGTERM,
                  grace, SIGKILL) and exit with the failing rc.
+
+Self-healing also covers failures with NO exit code: a liveness monitor
+in the `_watch` poll scrapes each child's /status (hard timeout — a hung
+child can never hang the monitor) and declares a child *wedged* when its
+step counter freezes past MGWFBP_LIVENESS_GRACE_S (or /healthz goes
+503-sticky that long), *unreachable* when a previously-seen endpoint
+stops answering; either verdict SIGTERMs the group and heals it the same
+way. Every failure/heal decision is appended to the supervisor's own
+telemetry stream (`telemetry.supervisor.jsonl`, process_index -1).
 
 Launch contract (what each child sees): MGWFBP_COORDINATOR,
 MGWFBP_NUM_PROCESSES, MGWFBP_PROCESS_ID — the env chain train_cli's
@@ -73,10 +91,114 @@ from typing import Callable, Optional, Sequence
 
 from mgwfbp_tpu.utils.faults import PREEMPT_RC
 from mgwfbp_tpu.utils.logging import get_logger
+from mgwfbp_tpu.utils.platform import env_float
 
 # utils/watchdog.py exits the process with os._exit(86) after dumping all
 # thread stacks; keep in sync (the watchdog predates this constant)
 WATCHDOG_RC = 86
+
+# self-healing (ISSUE 20): how long a child's /status step may stay
+# frozen (or its endpoint unreachable after having been seen) before the
+# liveness monitor declares it wedged/unreachable and heals the group
+LIVENESS_GRACE_ENV = "MGWFBP_LIVENESS_GRACE_S"
+DEFAULT_LIVENESS_GRACE_S = 120.0
+
+# failure classes a child exit decodes to (classify_rc) — the healing
+# policy and the `failure` telemetry event share this vocabulary
+HEAL_CLASSES = (
+    "crash", "oom_kill", "wedge", "unreachable", "term",
+)
+
+
+def classify_rc(rc: int) -> str:
+    """Decode one child returncode into the rc-policy vocabulary.
+
+    Popen returncodes are negative for signal deaths (-N = killed by
+    signal N); a shell-style 128+N is decoded the same way so the table
+    holds for rcs relayed through an intermediate shell. SIGKILL is
+    'oom_kill' — on Linux the OOM killer delivers exactly SIGKILL, and a
+    sibling that was SIGKILLed by an operator heals identically (the
+    slot's memory demand is suspect either way, so the healer SHRINKS
+    rather than relaunching the same footprint). SIGTERM is 'term': an
+    external/preempt-style stop that never drained — recoverable at the
+    same world.
+    """
+    if rc == 0:
+        return "ok"
+    if rc == PREEMPT_RC:
+        return "preempt"
+    if rc == WATCHDOG_RC:
+        return "watchdog"
+    sig = -rc if rc < 0 else (rc - 128 if 128 < rc < 160 else None)
+    if sig == int(signal.SIGKILL):
+        return "oom_kill"
+    if sig in (int(signal.SIGTERM), int(signal.SIGINT)):
+        return "term"
+    return "crash"
+
+
+class _LivenessTracker:
+    """Per-child liveness state machine for the `_watch` poll.
+
+    Fed one `/status` scrape (or None) per child per poll; classifies
+    each child as 'running', 'wedged' (alive but its step counter froze
+    past the grace, or /status reports sticky-unhealthy past the grace),
+    'unreachable' (endpoint stopped answering after having been seen),
+    or 'unknown' (never seen — still booting/compiling; pre-step hangs
+    are the in-process watchdog's domain, not ours). Pure host state
+    driven by an injected clock — unit-testable without processes.
+    """
+
+    def __init__(self) -> None:
+        self._step: dict[int, int] = {}
+        self._step_t: dict[int, float] = {}
+        self._seen: set[int] = set()
+        self._unhealthy_t: dict[int, float] = {}
+        self._unreachable_t: dict[int, float] = {}
+
+    def observe(self, idx: int, status, now: float) -> None:
+        if status is None:
+            # only a child that HAS answered can become unreachable —
+            # never-seen children are booting, not lost
+            if idx in self._seen:
+                self._unreachable_t.setdefault(idx, now)
+            return
+        self._seen.add(idx)
+        self._unreachable_t.pop(idx, None)
+        step = int(status.get("step") or 0)
+        if step != self._step.get(idx):
+            self._step[idx] = step
+            self._step_t[idx] = now
+        elif idx not in self._step_t:
+            self._step_t[idx] = now
+        if status.get("healthy") is False:
+            self._unhealthy_t.setdefault(idx, now)
+        else:
+            self._unhealthy_t.pop(idx, None)
+
+    def classify(self, idx: int, now: float, grace_s: float) -> str:
+        if idx not in self._seen:
+            return "unknown"
+        t = self._unreachable_t.get(idx)
+        if t is not None and now - t > grace_s:
+            return "unreachable"
+        t = self._unhealthy_t.get(idx)
+        if t is not None and now - t > grace_s:
+            return "wedged"
+        # a frozen step only counts once the child has EVER stepped:
+        # compile/bootstrap legitimately sits at step 0 for a long time
+        if (
+            self._step.get(idx, 0) >= 1
+            and now - self._step_t.get(idx, now) > grace_s
+        ):
+            return "wedged"
+        return "running"
+
+    def max_step(self) -> int:
+        """Highest step any child ever reported (crash-loop detection:
+        the same max step across consecutive healed incarnations means
+        the group is dying at the same point every life)."""
+        return max(self._step.values(), default=0)
 
 
 def free_port() -> int:
@@ -138,6 +260,11 @@ class Supervisor:
         resize_to: Optional[int] = None,
         serve_replicas: int = 0,
         serve_cmd: Optional[Sequence[str]] = None,
+        heal: bool = True,
+        heal_max_restarts: int = 2,
+        heal_same_step_limit: int = 3,
+        liveness_grace_s: Optional[float] = None,
+        serve_max_restarts: int = 3,
         sleep: Callable[[float], None] = time.sleep,
     ):
         if processes < 1:
@@ -199,6 +326,47 @@ class Supervisor:
         self._serve_procs: list = []
         self._serve_logs: list = []
         self._serve_exit_warned: set = set()
+        # self-healing (ISSUE 20): hard failures (crash/oom/wedge/
+        # unreachable) heal the group instead of tearing it down —
+        # relaunch at the same world when the slot looks recoverable,
+        # SHRINK to the survivor count (elastic resume) when not, under
+        # per-failure-class restart budgets. heal=False keeps the old
+        # teardown-and-propagate policy verbatim.
+        self.heal = bool(heal)
+        self.heal_max_restarts = int(heal_max_restarts)
+        self.heal_same_step_limit = int(heal_same_step_limit)
+        # garbage in the env knob must fail NOW, naming the variable —
+        # not mid-heal (env_float = the MGWFBP_BARRIER_TIMEOUT_S contract)
+        self.liveness_grace_s = (
+            float(liveness_grace_s)
+            if liveness_grace_s is not None
+            else env_float(
+                LIVENESS_GRACE_ENV, DEFAULT_LIVENESS_GRACE_S,
+                environ=self.env,
+            )
+        )
+        self._liveness = _LivenessTracker()
+        self._liveness_poll_t = 0.0
+        # the failure the current incarnation is dying of: set by the
+        # liveness monitor (wedge/unreachable — it SIGTERMs the group,
+        # so every child exits 75 and the rc vector alone would look
+        # like a plain preempt) or by the hard-exit path in _watch
+        self._pending_failure: Optional[dict] = None
+        # slot index -> rc for children that exited HARD this
+        # incarnation, captured before teardown pollutes the rc vector
+        # with its own -15/-9
+        self._failed_slots: dict[int, int] = {}
+        self._heal_restarts: dict[str, int] = {}
+        # max observed step per healed incarnation (crash-loop detection)
+        self._crash_steps: list[int] = []
+        self._postmortem_paths: list[str] = []
+        # serve-replica restart policy (satellite): respawn with backoff
+        # under an own budget instead of the old spawn-once
+        self.serve_max_restarts = int(serve_max_restarts)
+        self._serve_restarts: list[int] = []
+        self._serve_respawn_at: dict[int, float] = {}
+        self._incarnation = 0
+        self._events = None  # lazy supervisor-stream EventWriter
 
     # -- launch ------------------------------------------------------------
     def _metrics_base_port(self) -> Optional[int]:
@@ -336,18 +504,54 @@ class Supervisor:
             )
         self._last_fleet_targets = dict(targets)
 
+    def _emit(self, event: str, **fields) -> None:
+        """Append one record to the supervisor's OWN telemetry stream
+        (`telemetry.supervisor.jsonl` — deliberately outside
+        find_stream_paths' per-process pattern, so per-run merges only
+        see it when asked for explicitly). process_index -1 marks the
+        emitter as nobody's training rank. Best-effort: telemetry must
+        never be what kills the healer."""
+        if not self.log_dir:
+            return
+        try:
+            if self._events is None:
+                from mgwfbp_tpu.telemetry.events import EventWriter
+
+                os.makedirs(self.log_dir, exist_ok=True)
+                self._events = EventWriter(
+                    os.path.join(
+                        self.log_dir, "telemetry.supervisor.jsonl"
+                    ),
+                    run={"process_index": -1, "role": "supervisor"},
+                )
+            self._events.emit(event, **fields)
+        except Exception as e:  # noqa: BLE001 — observability best-effort
+            self.log.warning(
+                "could not emit %s telemetry event: %s", event, e
+            )
+
     def _fleet_meta(self) -> dict:
         """Supervisor-level fields for /fleet/status."""
         meta = {
             "incarnation": len(self.results),
             "processes_configured": self.processes,
         }
+        meta["heal"] = {
+            "enabled": self.heal,
+            "restarts": dict(self._heal_restarts),
+            "budget": self.heal_max_restarts,
+            "liveness_grace_s": self.liveness_grace_s,
+        }
+        if self._pending_failure is not None:
+            meta["heal"]["pending_failure"] = dict(self._pending_failure)
         if self.serve_replicas:
             meta["serving"] = {
                 "replicas": self.serve_replicas,
                 "alive": sum(
                     1 for p in self._serve_procs if p.poll() is None
                 ),
+                "restarts": list(self._serve_restarts),
+                "restart_budget": self.serve_max_restarts,
             }
         if self.resize_to is not None:
             # the transition is fleet-visible: pending while the group
@@ -450,11 +654,16 @@ class Supervisor:
             # is the expected case; the snapshot is best-effort
             return None
 
-    def _child_env(self, idx: int, port: int) -> dict:
+    def _child_env(self, idx: int, port: int, incarnation: int = 0) -> dict:
         env = dict(self.env)
         env["MGWFBP_COORDINATOR"] = f"127.0.0.1:{port}"
         env["MGWFBP_NUM_PROCESSES"] = str(self.processes)
         env["MGWFBP_PROCESS_ID"] = str(idx)
+        # which life this is: the fault plan's HARD kinds (kill/wedge —
+        # drain-less, so a healed relaunch resumes BELOW the fault step)
+        # key on this so a chaos fault fires in exactly one incarnation
+        # instead of re-firing every life (faults.for_incarnation)
+        env["MGWFBP_INCARNATION"] = str(incarnation)
         # supervised groups may resume across world-size changes: a
         # relaunch at a new --processes finds the old world's checkpoints
         # under their sibling tag and re-shards (trainer
@@ -489,7 +698,7 @@ class Supervisor:
             stderr = subprocess.STDOUT
         return subprocess.Popen(
             self.base_cmd,
-            env=self._child_env(idx, port),
+            env=self._child_env(idx, port, incarnation),
             stdout=stdout,
             stderr=stderr,
         ), stdout
@@ -516,34 +725,49 @@ class Supervisor:
                 env.setdefault("MGWFBP_METRICS_HOST", "0.0.0.0")
         return env
 
+    def _spawn_serve(self, i: int) -> None:
+        """(Re)spawn serve replica `i` into slot `i`. The log file is
+        opened append so a respawned replica's output lands after its
+        previous life's instead of erasing the evidence."""
+        if self._metrics_enabled():
+            try:
+                os.unlink(self._port_file(i, role="serve"))
+            except OSError:
+                pass
+        stdout = stderr = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(self.log_dir, f"serve{i}.log"),
+                "a", buffering=1,
+            )
+            stderr = subprocess.STDOUT
+        proc = subprocess.Popen(
+            self.serve_cmd,
+            env=self._serve_env(i),
+            stdout=stdout,
+            stderr=stderr,
+        )
+        if i < len(self._serve_procs):
+            old = self._serve_logs[i]
+            if old is not None:
+                old.close()
+            self._serve_procs[i] = proc
+            self._serve_logs[i] = stdout
+        else:
+            self._serve_procs.append(proc)
+            self._serve_logs.append(stdout)
+
     def _start_serve_replicas(self) -> None:
-        """Spawn the serve replicas once, for the supervisor's lifetime
+        """Spawn the serve replicas for the supervisor's lifetime
         (training-group resubmits and resizes must not churn them — each
         replica hot-reloads committed checkpoints on its own)."""
         if not self.serve_replicas or self._serve_procs:
             return
+        self._serve_restarts = [0] * self.serve_replicas
         base = self._metrics_base_port()
         for i in range(self.serve_replicas):
-            if self._metrics_enabled():
-                try:
-                    os.unlink(self._port_file(i, role="serve"))
-                except OSError:
-                    pass
-            stdout = stderr = None
-            if self.log_dir:
-                os.makedirs(self.log_dir, exist_ok=True)
-                stdout = open(
-                    os.path.join(self.log_dir, f"serve{i}.log"),
-                    "w", buffering=1,
-                )
-                stderr = subprocess.STDOUT
-            self._serve_procs.append(subprocess.Popen(
-                self.serve_cmd,
-                env=self._serve_env(i),
-                stdout=stdout,
-                stderr=stderr,
-            ))
-            self._serve_logs.append(stdout)
+            self._spawn_serve(i)
             if base is not None:
                 from mgwfbp_tpu.telemetry.serve import resolve_metrics_port
 
@@ -553,18 +777,58 @@ class Supervisor:
                     i, resolve_metrics_port(base, i, role="serve"),
                 )
 
-    def _reap_serve_replicas(self) -> None:
-        """A dead replica degrades serving capacity but never the
-        training job: warn once per replica, keep the group running."""
+    def _reap_serve_replicas(self, now: Optional[float] = None) -> None:
+        """Serve-replica restart policy (ISSUE 20 satellite): a dead
+        replica degrades serving capacity but never the training job —
+        respawn it after bounded exponential backoff, under the
+        replicas' OWN restart budget. Budget spent -> warn once and
+        leave the slot dead (the old spawn-once behavior, now the
+        endpoint of a policy instead of the whole policy)."""
+        if now is None:
+            now = time.monotonic()
         for i, p in enumerate(self._serve_procs):
-            if p.poll() is not None and i not in self._serve_exit_warned:
-                self._serve_exit_warned.add(i)
+            if p.poll() is None:
+                self._serve_respawn_at.pop(i, None)
+                continue
+            used = self._serve_restarts[i]
+            if used >= self.serve_max_restarts:
+                if i not in self._serve_exit_warned:
+                    self._serve_exit_warned.add(i)
+                    self.log.warning(
+                        "serve replica %d exited rc %d and its restart "
+                        "budget (%d) is spent; replica stays down "
+                        "(training continues%s)",
+                        i, p.returncode, self.serve_max_restarts,
+                        f" — see {self.log_dir}/serve{i}.log"
+                        if self.log_dir else "",
+                    )
+                continue
+            due = self._serve_respawn_at.get(i)
+            if due is None:
+                self._emit(
+                    "failure",
+                    **{"class": classify_rc(p.returncode)},
+                    target=f"serve{i}", rc=int(p.returncode),
+                )
+                delay = self.backoff_s(used + 1)
+                self._serve_respawn_at[i] = now + delay
                 self.log.warning(
-                    "serve replica %d exited rc %d (training continues; "
-                    "replica is NOT restarted%s)",
-                    i, p.returncode,
-                    f" — see {self.log_dir}/serve{i}.log"
-                    if self.log_dir else "",
+                    "serve replica %d exited rc %d; respawning in %.1fs "
+                    "(restart %d/%d)", i, p.returncode, delay,
+                    used + 1, self.serve_max_restarts,
+                )
+                continue
+            if now >= due:
+                self._serve_respawn_at.pop(i, None)
+                self._serve_restarts[i] += 1
+                self._spawn_serve(i)
+                self._emit(
+                    "heal", action="respawn_serve", target=f"serve{i}",
+                    restarts=self._serve_restarts[i],
+                )
+                self.log.info(
+                    "serve replica %d respawned (restart %d/%d)",
+                    i, self._serve_restarts[i], self.serve_max_restarts,
                 )
 
     def _stop_serve_replicas(self) -> None:
@@ -578,6 +842,12 @@ class Supervisor:
 
     def _run_group(self, incarnation: int) -> GroupResult:
         self._status_snapshots = None  # fresh capture per incarnation
+        # fresh failure/liveness state per incarnation (the PREVIOUS
+        # incarnation's verdicts were consumed by the rc policy already)
+        self._failed_slots = {}
+        self._pending_failure = None
+        self._liveness = _LivenessTracker()
+        self._liveness_poll_t = 0.0
         port = self.port if self.port is not None else free_port()
         self.log.info(
             "incarnation %d: launching %d process(es) (coordinator "
@@ -620,6 +890,83 @@ class Supervisor:
         )
         return result
 
+    def _capture_snapshots(self, procs) -> None:
+        """Last /status of every still-alive peer, captured the moment a
+        hard/watchdog exit is first observed — by the time run() applies
+        the rc policy every child is torn down and the ports refuse."""
+        if self._status_snapshots is not None:
+            return
+        self._status_snapshots = {
+            i: s for i, p in enumerate(procs)
+            if p.poll() is None
+            and (s := self._child_status(i)) is not None
+        }
+        for i, s in sorted(self._status_snapshots.items()):
+            for b in (s.get("postmortems") or {}).get("recent", []):
+                if b.get("path"):
+                    self._postmortem_paths.append(
+                        f"p{i}: {b['path']}"
+                    )
+
+    def _poll_liveness(self, procs) -> None:
+        """The wedge/unreachable detector (ISSUE 20): feed each alive
+        child's /status scrape (hard-timeout, same as the fleet fan-in's)
+        into the liveness tracker; the first child classified wedged or
+        unreachable marks the incarnation's pending failure and SIGTERMs
+        the whole group — survivors drain through the agreed-preempt
+        path (or their coordination deadline) and the rc policy heals."""
+        if (
+            not self.heal
+            or self._pending_failure is not None
+            or self._failed_slots
+            or not self._metrics_enabled()
+        ):
+            return
+        now = time.monotonic()
+        if now - self._liveness_poll_t < 1.0:  # throttle the scrapes
+            return
+        self._liveness_poll_t = now
+        # sweep EVERY alive child before passing a verdict: a single
+        # wedged process freezes its peers at the next merged collective
+        # within the same grace window, so the step-freeze signal cannot
+        # root-cause which peer wedged first — the honest verdict names
+        # the whole frozen set
+        culprits: list[tuple[int, str, int]] = []
+        for i, p in enumerate(procs):
+            if p.poll() is not None:
+                continue
+            self._liveness.observe(i, self._child_status(i), now)
+            verdict = self._liveness.classify(
+                i, now, self.liveness_grace_s
+            )
+            if verdict in ("wedged", "unreachable"):
+                culprits.append(
+                    (i, verdict, self._liveness._step.get(i, 0))
+                )
+        if not culprits:
+            return
+        cls = culprits[0][1]
+        target = ",".join(f"p{i}" for i, _, _ in culprits)
+        step = max(s for _, _, s in culprits)
+        self._pending_failure = {
+            "class": cls, "target": target, "step": step,
+        }
+        self.log.warning(
+            "%s is %s (step frozen at %d past %.0fs liveness grace); "
+            "SIGTERMing the group to drain and heal",
+            target, cls, step, self.liveness_grace_s,
+        )
+        self._emit(
+            "failure", **{"class": cls}, target=target, step=step,
+        )
+        self._capture_snapshots(procs)
+        for q in procs:
+            if q.poll() is None:
+                try:
+                    q.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+
     def _watch(self, procs) -> list[int]:
         """Poll until every process exits; once ANY process exits,
         stragglers get a bounded window before teardown. A group member
@@ -638,27 +985,59 @@ class Supervisor:
             self._reap_serve_replicas()
             # --resize-to: drain a healthy group once it is stepping
             self._maybe_trigger_resize(procs)
+            # wedge/unreachable detection (no-op once a failure is known)
+            self._poll_liveness(procs)
             pending = [p for p in procs if p.poll() is None]
-            if not pending:
-                return [int(p.returncode) for p in procs]
             done = [p.returncode for p in procs if p.returncode is not None]
             if WATCHDOG_RC in done and self._status_snapshots is None:
-                # capture NOW, while the aborting process's peers are
-                # still alive and serving /status — by the time run()
-                # applies the rc policy every child has been torn down
-                # and the ports refuse
-                self._status_snapshots = {
-                    i: s for i, p in enumerate(procs)
-                    if p.poll() is None
-                    and (s := self._child_status(i)) is not None
-                }
+                self._capture_snapshots(procs)
+            hard = {
+                i: int(p.returncode) for i, p in enumerate(procs)
+                if p.returncode is not None
+                and p.returncode not in (0, PREEMPT_RC, WATCHDOG_RC)
+            }
+            if (
+                self.heal
+                and hard
+                and not self._failed_slots
+                and WATCHDOG_RC not in done
+            ):
+                # hard exit(s): capture the failed slots NOW (teardown
+                # pollutes the rc vector with its own -15/-9 later) and
+                # SIGTERM the survivors — blocked in a collective their
+                # dead peer will never join, they drain via the agreed
+                # preempt path or their coordination deadline (rc 75)
+                self._failed_slots = dict(hard)
+                self._capture_snapshots(procs)
+                for i, rc in sorted(hard.items()):
+                    cls = classify_rc(rc)
+                    self.log.warning(
+                        "process %d exited HARD (rc %d, class %s); "
+                        "SIGTERMing survivors to drain for healing",
+                        i, rc, cls,
+                    )
+                    self._emit(
+                        "failure", **{"class": cls}, target=f"p{i}",
+                        rc=rc, step=self._liveness.max_step(),
+                    )
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+            if not pending:
+                return [int(p.returncode) for p in procs]
             if done and deadline is None:
                 # rc 0/75: peers are finishing up or drain-agreeing and
-                # checkpointing — give them the drain window. Anything
-                # else: the group is already broken; short fuse.
+                # checkpointing — give them the drain window. A hard
+                # exit under healing gets the SAME window: survivors
+                # must ride out their coordination deadline to exit
+                # clean. Anything else: broken group, short fuse.
+                clean = all(rc in (0, PREEMPT_RC) for rc in done)
                 grace = (
                     self.drain_grace_s
-                    if all(rc in (0, PREEMPT_RC) for rc in done)
+                    if clean or (self.heal and self._failed_slots)
                     else self.grace_s
                 )
                 deadline = time.monotonic() + grace
@@ -709,6 +1088,111 @@ class Supervisor:
             if self.fleet_server is not None:
                 self.fleet_server.close()
                 self.fleet_server = None
+
+    def _heal_exit_rc(self) -> int:
+        """The rc a give-up heal stop propagates: the failed child's own
+        positive rc when it had one, the conventional 128+signal for a
+        signal death, 1 for a wedge/unreachable (no child rc to speak
+        of — the group was SIGTERMed by the monitor)."""
+        rcs = sorted(self._failed_slots.values())
+        pos = [rc for rc in rcs if rc > 0]
+        if pos:
+            return pos[0]
+        neg = [rc for rc in rcs if rc < 0]
+        if neg:
+            return 128 + abs(neg[0])
+        return 1
+
+    def _heal_or_stop(self, result: GroupResult) -> Optional[int]:
+        """Apply the healing policy to one hard-failed incarnation.
+
+        Returns None when the group was healed (caller relaunches) or
+        the final exit rc when the policy gives up. The policy matrix:
+
+          oom_kill     -> SHRINK to the survivor count (the slot's
+                          memory footprint is suspect; elastic resume
+                          re-shards off the last committed step)
+          crash/term   -> relaunch at the SAME world (slot recoverable)
+          wedge/
+          unreachable  -> relaunch at the SAME world
+          any class    -> bounded by its own restart budget
+                          (heal_max_restarts per class) and a crash-loop
+                          detector (same max step heal_same_step_limit
+                          consecutive lives -> stop, postmortems named)
+        """
+        if self._pending_failure is not None:
+            cls = str(self._pending_failure["class"])
+            target = str(self._pending_failure["target"])
+        else:
+            idx = min(self._failed_slots)
+            cls = classify_rc(self._failed_slots[idx])
+            target = f"p{idx}"
+        step = self._liveness.max_step()
+        bundles = (
+            " Postmortem bundle(s): " + "; ".join(self._postmortem_paths)
+            if self._postmortem_paths else ""
+        )
+        self._crash_steps.append(step)
+        tail = self._crash_steps[-self.heal_same_step_limit:]
+        if (
+            len(tail) >= self.heal_same_step_limit
+            and len(set(tail)) == 1
+        ):
+            self.log.error(
+                "crash loop: %d consecutive incarnation(s) died at step "
+                "%d (last failure: %s on %s) — the fault is "
+                "deterministic, healing cannot fix it; stopping.%s",
+                len(tail), step, cls, target, bundles,
+            )
+            self._emit(
+                "heal", action="stop", reason="crash_loop",
+                **{"class": cls}, target=target, step=step,
+            )
+            return self._heal_exit_rc()
+        used = self._heal_restarts.get(cls, 0)
+        if used >= self.heal_max_restarts:
+            self.log.error(
+                "%s on %s but the %r heal budget (%d) is spent; "
+                "stopping.%s",
+                cls, target, cls, self.heal_max_restarts, bundles,
+            )
+            self._emit(
+                "heal", action="stop", reason="budget",
+                **{"class": cls}, target=target, restarts=used,
+            )
+            return self._heal_exit_rc()
+        self._heal_restarts[cls] = used + 1
+        survivors = self.processes - len(self._failed_slots)
+        shrink = cls == "oom_kill" and 1 <= survivors < self.processes
+        delay = self.backoff_s(self._heal_restarts[cls])
+        if shrink:
+            self.log.warning(
+                "healing %s on %s: SHRINKING %d -> %d process(es) "
+                "(elastic resume off the last committed shard-native "
+                "step) in %.1fs (%s heal %d/%d)",
+                cls, target, self.processes, survivors, delay, cls,
+                self._heal_restarts[cls], self.heal_max_restarts,
+            )
+            self._emit(
+                "heal", action="shrink", **{"class": cls},
+                target=target, old_world=self.processes,
+                world=survivors, restarts=self._heal_restarts[cls],
+            )
+            self.processes = survivors
+        else:
+            self.log.warning(
+                "healing %s on %s: relaunching at the same world (%d) "
+                "in %.1fs (%s heal %d/%d)",
+                cls, target, self.processes, delay, cls,
+                self._heal_restarts[cls], self.heal_max_restarts,
+            )
+            self._emit(
+                "heal", action="relaunch", **{"class": cls},
+                target=target, world=self.processes,
+                restarts=self._heal_restarts[cls],
+            )
+        self.sleep(delay)
+        return None
 
     def _run_policy(self) -> int:
         restarts = 0
@@ -763,6 +1247,19 @@ class Supervisor:
                     WATCHDOG_RC, where, detail,
                 )
                 return WATCHDOG_RC
+            # self-healing (ISSUE 20): a hard failure this incarnation —
+            # a slot that exited crash/oom/term, or a wedge/unreachable
+            # verdict from the liveness monitor (whose SIGTERM made the
+            # rc vector look like a plain preempt) — takes the healing
+            # policy, NOT the free preempt resubmit below
+            if self.heal and (
+                self._pending_failure is not None or self._failed_slots
+            ):
+                rc = self._heal_or_stop(result)
+                if rc is not None:
+                    return rc
+                incarnation += 1
+                continue
             if not result.preempted:
                 bad = [
                     rc for rc in result.returncodes

@@ -157,6 +157,17 @@ EVENT_TYPES: dict[str, tuple[str, ...]] = {
     # slots since the last snapshot; latency quantiles ride as extras
     # (latency_p50_s/p95_s/p99_s over the recent-request window)
     "serve_stats": ("requests", "queue_depth", "batch_fill"),
+    # --- self-healing supervisor (ISSUE 20) -----------------------------
+    # the supervisor (or trainer) observed one HARD failure: `class` is
+    # 'crash' | 'oom_kill' | 'wedge' | 'unreachable' | 'coordination',
+    # `target` names the failed member ('p1', 'serve0', ...). rc/signal/
+    # step ride as extras when known
+    "failure": ("class", "target"),
+    # the supervisor's healing policy acted on a failure: `action` is
+    # 'relaunch' (same world) | 'shrink' (elastic resume at survivor
+    # count) | 'respawn_serve' | 'stop' (budget exhausted / crash loop).
+    # world/incarnation/restarts ride as extras
+    "heal": ("action",),
 }
 
 _JSON_SCALARS = (str, int, float, bool, type(None))

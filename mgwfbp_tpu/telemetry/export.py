@@ -235,6 +235,12 @@ METRICS: tuple[tuple[str, str, str], ...] = (
      "bad-step rollbacks to the last checkpoint"),
     ("mgwfbp_preempts_total", "counter", "graceful preemption drains"),
     ("mgwfbp_resumes_total", "counter", "restarts from a saved snapshot"),
+    # self-healing supervisor (ISSUE 20)
+    ("mgwfbp_failures_total", "counter",
+     "hard failures observed (crash/oom_kill/wedged/unreachable/"
+     "coordination)"),
+    ("mgwfbp_heals_total", "counter",
+     "healing actions applied (relaunch/shrink/respawn_serve/stop)"),
     ("mgwfbp_drift_alarms_total", "counter",
      "cost-model drift alarms raised (telemetry.drift)"),
     ("mgwfbp_drift_residual", "gauge",
@@ -312,6 +318,8 @@ EVENT_COUNTERS: dict[str, str] = {
     "rollback": "mgwfbp_rollbacks_total",
     "preempt": "mgwfbp_preempts_total",
     "resume": "mgwfbp_resumes_total",
+    "failure": "mgwfbp_failures_total",
+    "heal": "mgwfbp_heals_total",
     "profile": "mgwfbp_profile_windows_total",
     "postmortem": "mgwfbp_postmortems_total",
     "reload": "mgwfbp_serve_reloads_total",

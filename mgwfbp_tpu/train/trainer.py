@@ -52,6 +52,7 @@ from mgwfbp_tpu.train.step import (
     make_train_step,
 )
 from mgwfbp_tpu.utils.faults import FaultPlan, Preempted
+from mgwfbp_tpu.utils.platform import env_int
 from mgwfbp_tpu.utils.logging import get_logger
 
 
@@ -281,7 +282,14 @@ class Trainer:
         self.autotune_report = None  # set by autotune() (cache hit or race)
         # resilience layer (ISSUE 5): deterministic fault plan, graceful
         # preemption drain, non-finite-step bookkeeping, mid-epoch resume
-        self._faults = FaultPlan.from_env().for_process(jax.process_index())
+        # for_incarnation: the supervisor exports MGWFBP_INCARNATION per
+        # (re)launch; HARD chaos kinds (kill/wedge, ISSUE 20) key on it
+        # so a healed relaunch does not re-fire the fault it died of
+        self._faults = (
+            FaultPlan.from_env()
+            .for_process(jax.process_index())
+            .for_incarnation(env_int("MGWFBP_INCARNATION", 0))
+        )
         if self._faults:
             self.log.info("fault plan armed: %s", self._faults.describe())
         # live observability plane (ISSUE 9): online cost-model drift
@@ -3010,6 +3018,9 @@ class Trainer:
                     stall_s, self.iteration + 1,
                 )
                 time.sleep(stall_s)
+            wedge_s = self._faults.wedge_secs(self.iteration + 1)
+            if wedge_s > 0:
+                self._wedge(wedge_s)
             if self._faults.nan_at(self.iteration + 1):
                 batch, poisoned = _poison_batch(batch)
                 if poisoned:
@@ -3092,6 +3103,15 @@ class Trainer:
             sig = self._faults.preempt_signal_after(self.iteration)
             if sig is not None:
                 self._deliver_preempt(sig)
+            if self._faults.kill_after(self.iteration):
+                # chaos (ISSUE 20): a drain-less HARD crash — no
+                # checkpoint barrier, no telemetry flush, nothing. The
+                # supervisor's healer is what recovers the group.
+                self.log.warning(
+                    "fault injection: SIGKILL self after step %d "
+                    "(drain-less hard crash)", self.iteration,
+                )
+                os.kill(os.getpid(), _signal.SIGKILL)
             if self._agreed_preempt():
                 self._graceful_drain(epoch, epoch_pos)  # raises Preempted
             # live observability (ISSUE 9): straggler probe + armed drift
@@ -3249,6 +3269,29 @@ class Trainer:
         # loop consumes the flag at boundaries, so a lost re-set at worst
         # delays the drain by the one step the escalation path covers
         self._preempt_signal = name
+
+    def _wedge(self, secs: float) -> None:
+        """Chaos (ISSUE 20): stop stepping for `secs` — the liveness
+        monitor's wedge signature (frozen /status step) while /healthz
+        and /status keep serving from their daemon thread. Sliced sleep
+        so a delivered preempt signal (the supervisor's heal SIGTERM)
+        interrupts the wedge and the normal drain path takes over; no
+        watchdog beat on purpose (a real wedge would not beat either)."""
+        self.log.warning(
+            "fault injection: wedging for %.3g s before step %d "
+            "(stepping stops; HTTP keeps serving)",
+            secs, self.iteration + 1,
+        )
+        deadline = time.monotonic() + secs
+        while time.monotonic() < deadline:
+            if self._preempt_signal is not None:
+                self.log.warning(
+                    "wedge interrupted by %s; resuming the step loop "
+                    "(drain takes over at the boundary)",
+                    self._preempt_signal,
+                )
+                return
+            time.sleep(min(0.2, max(deadline - time.monotonic(), 0.0)))
 
     def _deliver_preempt(self, sig: int) -> None:
         """Fault-plan preemption: deliver the real signal when our handler
@@ -4748,6 +4791,21 @@ class Trainer:
                     # the loop itself never does)
                     self._measure_group_times_live()
                 metrics = self._fit_epochs(self.start_epoch, end, cfg)
+        except coord.CoordinationTimeout as ct:
+            # a peer is dead or wedged: every further collective —
+            # including the checkpoint barrier — would hang, so record
+            # the failure and exit DRAIN-LESS (train_cli maps this to
+            # rc 75; the supervisor heals from the last committed step)
+            self._emit_event(
+                "failure", **{"class": "coordination"},
+                target=f"p{jax.process_index()}",
+                step=int(self.iteration), op=ct.op,
+            )
+            self.log.error(
+                "coordination timeout in %r at step %d: %s",
+                ct.op, self.iteration, ct,
+            )
+            raise
         finally:
             self._disarm_signals()
             self._watchdog = None

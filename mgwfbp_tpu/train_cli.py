@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 from typing import Optional
 
 from mgwfbp_tpu.config import PRESETS, TrainConfig, make_config
@@ -328,6 +329,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         profile_backward=not args.no_profile_backward,
         synthetic_data=True if args.synthetic else None,
     )
+    from mgwfbp_tpu.runtime.coordination import CoordinationTimeout
     from mgwfbp_tpu.utils.faults import PREEMPT_RC, Preempted
 
     try:
@@ -340,6 +342,24 @@ def main(argv: Optional[list[str]] = None) -> int:
             "epoch": p.epoch, "iteration": p.iteration,
         }))
         return PREEMPT_RC
+    except CoordinationTimeout as ct:
+        # a peer died or wedged mid-collective: the DRAIN-LESS
+        # restart-friendly exit (no checkpoint barrier can complete
+        # either) — the supervisor's healer resumes the group from the
+        # last COMMITTED shard-native step
+        print(json.dumps({
+            "coordination_timeout": True, "op": ct.op,
+            "timeout_s": ct.timeout_s,
+            "iteration": trainer.iteration,
+        }), flush=True)
+        # with a peer dead, the distributed runtime's atexit shutdown
+        # barrier can never complete — it waits out the peer's heartbeat
+        # timeout and then LOG(FATAL)s (SIGABRT), overriding the rc.
+        # Flush our own state and leave without interpreter teardown.
+        trainer.close()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(PREEMPT_RC)
     finally:
         trainer.close()
     print(json.dumps(metrics))

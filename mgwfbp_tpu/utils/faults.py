@@ -31,6 +31,15 @@ Plan grammar (``MGWFBP_FAULT_PLAN``)::
     chip_unavailable            backend init reports the chip as
                                 unavailable (bench.py's ChipUnavailable
                                 structured-skip path)
+    kill@step=N                 SIGKILL self after step N completes — a
+                                HARD crash, no drain, no checkpoint
+                                barrier (the supervisor's healer is what
+                                recovers the group); ONCE
+    wedge@step=N,secs=S         stop stepping for S seconds at step N
+                                (signal-interruptible sleep, /healthz
+                                and /status keep serving) — the
+                                liveness monitor's frozen-step signature
+                                without killing anything; ONCE
 
 Every kind additionally takes ``proc=I``: the spec fires only on the
 process with that index (multi-host runs share one MGWFBP_FAULT_PLAN env
@@ -38,6 +47,15 @@ across the group; ``preempt@step=4,proc=1`` preempts exactly one host so
 the agreed group drain is what gets exercised). The trainer applies the
 filter via ``FaultPlan.for_process``; a plan without ``proc=`` fires on
 every process, exactly as before.
+
+The HARD kinds (kill/wedge) additionally take ``inc=K`` (default 0): the
+spec fires only in supervisor incarnation K. Kill and wedge are
+drain-less, so the healed relaunch resumes BELOW the fault step — the
+crossing semantics below would re-fire the same fault in every life and
+the run could never complete. The supervisor exports
+``MGWFBP_INCARNATION`` per (re)launch and the trainer applies
+``FaultPlan.for_incarnation``, so ``kill@step=4,proc=1`` fires exactly
+once, in the first life.
 
 Everything is keyed on deterministic host counters — no randomness — so a
 faulted run is exactly reproducible, and a resumed run whose iteration
@@ -80,18 +98,22 @@ class Preempted(RuntimeError):
         self.epoch = epoch
         self.iteration = iteration
 
-KINDS = ("nan", "stall", "preempt", "chip_unavailable")
+KINDS = ("nan", "stall", "preempt", "chip_unavailable", "kill", "wedge")
 _ALLOWED_KEYS = {
     "nan": {"step", "count", "proc"},
     "stall": {"secs", "phase", "step", "proc"},
     "preempt": {"step", "signal", "proc"},
     "chip_unavailable": {"proc"},
+    "kill": {"step", "proc", "inc"},
+    "wedge": {"step", "secs", "proc", "inc"},
 }
 _REQUIRED_KEYS = {
     "nan": {"step"},
     "stall": {"secs"},
     "preempt": {"step"},
     "chip_unavailable": set(),
+    "kill": {"step"},
+    "wedge": {"step", "secs"},
 }
 _SIGNALS = {"SIGTERM": signal.SIGTERM, "SIGINT": signal.SIGINT}
 # the phases the trainer actually queries; an unknown phase would parse
@@ -113,6 +135,7 @@ class FaultSpec:
     phase: str = "train"
     signal: str = "SIGTERM"
     proc: Optional[int] = None  # None = fire on every process
+    inc: int = 0  # kill/wedge: supervisor incarnation the spec fires in
     fired: bool = False  # one-shot kinds (stall/preempt) consume themselves
     fired_steps: set = dataclasses.field(default_factory=set)  # nan kind
     observed_below: bool = False  # preempt: a step < `step` was seen, so
@@ -130,8 +153,12 @@ class FaultSpec:
             kv.append(f"phase={self.phase}")
         if self.kind == "preempt":
             kv.append(f"signal={self.signal}")
+        if self.kind == "wedge":
+            kv.append(f"secs={self.secs:g}")
         if self.proc is not None:
             kv.append(f"proc={self.proc}")
+        if self.kind in ("kill", "wedge") and self.inc:
+            kv.append(f"inc={self.inc}")
         return self.kind + ("@" + ",".join(kv) if kv else "")
 
 
@@ -182,12 +209,16 @@ def parse_plan(text: str) -> "FaultPlan":
                 spec.secs = float(kv["secs"])
             if "proc" in kv:
                 spec.proc = int(kv["proc"])
+            if "inc" in kv:
+                spec.inc = int(kv["inc"])
         except ValueError:
             raise ValueError(
                 f"fault plan: non-numeric value in {raw!r}; {GRAMMAR}"
             ) from None
         if spec.proc is not None and spec.proc < 0:
             raise ValueError("fault plan: proc must be >= 0")
+        if spec.inc < 0:
+            raise ValueError("fault plan: inc must be >= 0")
         if "phase" in kv:
             if kv["phase"] not in _PHASES:
                 raise ValueError(
@@ -207,6 +238,8 @@ def parse_plan(text: str) -> "FaultPlan":
             raise ValueError("fault plan: nan count must be >= 1")
         if spec.kind == "stall" and spec.secs < 0:
             raise ValueError("fault plan: stall secs must be >= 0")
+        if spec.kind == "wedge" and spec.secs < 0:
+            raise ValueError("fault plan: wedge secs must be >= 0")
         specs.append(spec)
     return FaultPlan(specs)
 
@@ -238,6 +271,20 @@ class FaultPlan:
         return FaultPlan([
             s for s in self.specs
             if s.proc is None or s.proc == int(process_index)
+        ])
+
+    def for_incarnation(self, incarnation: int) -> "FaultPlan":
+        """Drop HARD specs (kill/wedge) addressed to a different
+        supervisor incarnation. Kill/wedge are drain-less: the healed
+        relaunch resumes BELOW the fault step, so without this filter
+        the crossing semantics would re-fire the fault in every life
+        and the chaos run could never complete. Soft kinds pass through
+        unfiltered — their one-shot/crossing semantics already handle
+        resumption."""
+        return FaultPlan([
+            s for s in self.specs
+            if s.kind not in ("kill", "wedge")
+            or s.inc == int(incarnation)
         ])
 
     # -- queries (all deterministic in the host counters) -----------------
@@ -295,3 +342,34 @@ class FaultPlan:
 
     def chip_unavailable(self) -> bool:
         return any(s.kind == "chip_unavailable" for s in self.specs)
+
+    def kill_after(self, step: int) -> bool:
+        """True when the process must SIGKILL ITSELF after step `step`
+        completed (drain-less hard crash). Same live-crossing semantics
+        as preempt_signal_after — a resumed counter already past the
+        planned step consumes the spec silently (belt-and-braces under
+        the ``inc=`` filter)."""
+        for s in self.specs:
+            if s.kind != "kill" or s.fired:
+                continue
+            if step < s.step:
+                s.observed_below = True
+                continue
+            s.fired = True
+            if s.observed_below or step == s.step:
+                return True
+        return False
+
+    def wedge_secs(self, step: int) -> float:
+        """Seconds to stop stepping at exactly step `step` (0.0 = none).
+        One-shot, exact-step only — a wedge is a liveness-signature
+        fault and must freeze the step counter at precisely the planned
+        point, never "on the first call after resume"."""
+        for s in self.specs:
+            if s.kind != "wedge" or s.fired:
+                continue
+            if s.step != step:
+                continue
+            s.fired = True
+            return s.secs
+        return 0.0

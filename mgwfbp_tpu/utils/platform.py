@@ -162,6 +162,36 @@ def peak_flops(device_kind: str):
     return None
 
 
+def env_float(
+    name: str, default: float, environ=None,
+) -> float:
+    """Parse a float knob from the environment, failing fast WITH THE
+    VARIABLE NAMED on garbage input (the MGWFBP_BARRIER_TIMEOUT_S
+    precedent: a typo'd timeout must not surface as a bare float()
+    traceback mid-drain, or worse silently fall back to a default that
+    changes healing behavior). Unset/empty returns `default`."""
+    raw = ((environ if environ is not None else os.environ).get(name)
+           or "").strip()
+    if not raw:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number") from None
+
+
+def env_int(name: str, default: int, environ=None) -> int:
+    """`env_float`'s integer sibling (same fail-fast naming contract)."""
+    raw = ((environ if environ is not None else os.environ).get(name)
+           or "").strip()
+    if not raw:
+        return int(default)
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+
+
 class DeadlineExceeded(RuntimeError):
     """run_with_deadline hit its timeout (the worker thread is abandoned)."""
 

@@ -288,6 +288,8 @@ def test_supervisor_stops_on_watchdog_abort():
 
 
 def test_supervisor_tears_down_stragglers_on_crash():
+    """heal=False pins the legacy teardown-and-propagate policy (the
+    healing policy has its own suite in test_selfheal.py)."""
     import time
 
     script = (
@@ -296,7 +298,7 @@ def test_supervisor_tears_down_stragglers_on_crash():
         "    sys.exit(3)\n"
         "time.sleep(300)\n"
     )
-    sup = _stub_supervisor(script, grace_s=1.0)
+    sup = _stub_supervisor(script, grace_s=1.0, heal=False)
     t0 = time.monotonic()
     assert sup.run() == 3
     assert time.monotonic() - t0 < 30  # did not wait out the sleeper

@@ -346,6 +346,56 @@ def test_async_writer_with_slot_handoff_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_liveness_monitor_on_a_thread_is_caught():
+    """The ISSUE-20 liveness monitor, as the obvious first draft: a
+    background thread feeding the per-child tracker dicts while the
+    policy loop reads/clears them — THR001 must catch it. The shipped
+    monitor (runtime/supervisor._poll_liveness) avoids the race by
+    construction: tracker state lives entirely in the single-threaded
+    `_watch` poll, and this twin is the gate that keeps a future
+    'move the scrapes to a thread' refactor honest."""
+    findings = _check(
+        "import threading\n"
+        "class Monitor:\n"
+        "    def __init__(self):\n"
+        "        self._steps = {}\n"
+        "        self._verdicts = {}\n"
+        "        t = threading.Thread(target=self._scrape_loop)\n"
+        "        t.start()\n"
+        "    def _scrape_loop(self):\n"
+        "        while True:\n"
+        "            self._steps[0] = self._steps.get(0, 0) + 1\n"
+        "            self._verdicts[0] = 'wedged'\n"
+        "    def heal_policy(self):\n"
+        "        v = self._verdicts.pop(0, None)\n"
+        "        if v == 'wedged':\n"
+        "            self._steps.clear()\n"
+        "        return v\n"
+    )
+    thr1 = [f for f in findings if f.rule_id == "THR001"]
+    assert thr1, [f.format() for f in findings]
+    flagged = " ".join(f.message for f in thr1)
+    assert "Monitor._steps" in flagged or "Monitor._verdicts" in flagged
+
+
+def test_liveness_monitor_poll_confined_is_clean():
+    # the shipped shape: scrapes and verdicts both live in the one
+    # poll-loop context; the only thread is elsewhere (no shared state)
+    findings = _check(
+        "class Monitor:\n"
+        "    def __init__(self):\n"
+        "        self._steps = {}\n"
+        "        self._verdicts = {}\n"
+        "    def poll(self, scrape):\n"
+        "        self._steps[0] = scrape\n"
+        "        if scrape == self._steps.get(0):\n"
+        "            self._verdicts[0] = 'wedged'\n"
+        "    def heal_policy(self):\n"
+        "        return self._verdicts.pop(0, None)\n"
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
 # --------------------------------------------------------------------------
 # `# graft: thread-safe -- reason` grammar + ANA001 round-trip
 # --------------------------------------------------------------------------

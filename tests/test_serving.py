@@ -447,8 +447,11 @@ def test_supervisor_serve_replica_namespace(tmp_path):
     roles = {g["labels"]["process"]: g["labels"]["role"] for g in doc}
     assert roles["0"] == "train" and roles["1"] == "train"
     assert roles["serve0"] == "serve" and roles["serve1"] == "serve"
-    # serving meta rides /fleet/status
-    assert sup._fleet_meta()["serving"] == {"replicas": 2, "alive": 0}
+    # serving meta rides /fleet/status, including per-replica restart
+    # accounting from the self-healing respawn policy
+    assert sup._fleet_meta()["serving"] == {
+        "replicas": 2, "alive": 0, "restarts": [], "restart_budget": 3,
+    }
 
 
 # ---------------------------------------------------------------------------

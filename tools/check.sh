@@ -7,14 +7,14 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/9] ruff =="
+echo "== [1/10] ruff =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mgwfbp_tpu tests tools bench.py || rc=1
 else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== [2/9] mgwfbp_tpu.analysis (jit-safety lint -> THR race checker -> SPMD lockstep checker -> schedule verifier) =="
+echo "== [2/10] mgwfbp_tpu.analysis (jit-safety lint -> THR race checker -> SPMD lockstep checker -> schedule verifier) =="
 # cheapest-first inside the CLI: the THR host-concurrency pass and the
 # RUN-family SPMD pass statically prove the threading and the multi-host
 # protocol sound in ~1 s each, so a race/coordination bug fails HERE in
@@ -51,30 +51,36 @@ if [ "$thr_rc" -ne 32 ]; then
     rc=1
 fi
 
-echo "== [3/9] telemetry report smoke (writer -> report -> exports) =="
+echo "== [3/10] telemetry report smoke (writer -> report -> exports) =="
 JAX_PLATFORMS=cpu python tools/telemetry_report.py --selftest >/dev/null || rc=1
 
-echo "== [4/9] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
+echo "== [4/10] fault-injection smoke (NaN skip + preempt/resume lifecycle) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py || rc=1
 
-echo "== [5/9] async-checkpoint smoke (step-time envelope vs ckpt-off + async event contract) =="
+echo "== [5/10] async-checkpoint smoke (step-time envelope vs ckpt-off + async event contract) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --async-ckpt || rc=1
 
-echo "== [6/9] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
+echo "== [6/10] multi-host smoke (2-process agreed drain -> supervisor resubmit -> resume; /fleet/status straggler table probed mid-run) =="
 # hard timeout: a coordination bug's failure mode is a distributed HANG —
 # and so is a fleet fan-in bug's — which must fail the gate, not wedge it
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --processes 2 || rc=1
 
-echo "== [7/9] elastic-resize smoke (supervisor-triggered drain -> relaunch at 1 process from the shard-native checkpoint -> resume to completion) =="
+echo "== [7/10] elastic-resize smoke (supervisor-triggered drain -> relaunch at 1 process from the shard-native checkpoint -> resume to completion) =="
 # same hard-timeout contract: a resize hang (re-shard deadlock, a child
 # that never finds the sibling checkpoint) must FAIL the gate, not wedge it
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --resize || rc=1
 
-echo "== [8/9] serving-plane smoke (--serve-shadow run answers POST /predict mid-run; served step advances across mid-epoch commits; step-time envelope vs serve-off) =="
+echo "== [8/10] serving-plane smoke (--serve-shadow run answers POST /predict mid-run; served step advances across mid-epoch commits; step-time envelope vs serve-off) =="
 # same hard-timeout contract: a reload/dispatch hang must FAIL the gate
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --serve || rc=1
 
-echo "== [9/9] tier-1 tests =="
+echo "== [9/10] chaos smoke (SIGKILL mid-epoch -> shrink to survivors; wedge -> liveness heal in bounded time) =="
+# same hard-timeout contract: an unhealed chaos fault's failure mode is a
+# group that never finishes — the self-healing loop must land WELL inside
+# this window or the gate fails
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --chaos || rc=1
+
+echo "== [10/10] tier-1 tests =="
 t1log="$(mktemp -t mgwfbp_t1.XXXXXX.log)"  # private path: concurrent runs
 trap 'rm -f "$t1log" "$thr_probe"' EXIT    # must not clobber each other
 timeout -k 10 1260 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

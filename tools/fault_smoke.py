@@ -39,6 +39,16 @@ stage, exactly like a coordination hang), /fleet/metrics must merge both
 children under a `process` label, and the `fleet.json` http_sd sidecar
 must persist both children's ACTUAL metrics endpoints.
 
+``--chaos`` runs the SELF-HEALING lifecycle (ISSUE 20) against
+drain-less faults the agreed-preempt machinery cannot survive on its
+own: a mid-epoch SIGKILL (supervisor classifies the -9, shrinks to the
+survivors, elastic-resumes off the last committed shard-native step)
+and a 300 s wedge (alive, serving HTTP, not stepping — only the
+liveness monitor's frozen-step verdict can see it; the heal must land
+in bounded wall-clock time). Both scenarios pin failure/heal events in
+the supervisor's own telemetry stream and a monotonic merged timeline
+across the heal.
+
 Asserts the telemetry lifecycle after each run. No accelerator, dataset,
 or network needed.
 """
@@ -758,6 +768,244 @@ def serve_smoke() -> dict:
     }
 
 
+def chaos_smoke() -> dict:
+    """ISSUE 20: the self-healing supervisor, end to end, against DRAIN-
+    LESS faults — failures that never say goodbye, which the agreed-
+    preempt machinery alone cannot survive.
+
+    Scenario A (kill -> shrink): a 2-process group; ``kill@step=4,
+    proc=1`` SIGKILLs process 1 mid-epoch (no drain, no checkpoint, no
+    peer agreement). The survivor is left blocked in the merged
+    collective; its ``MGWFBP_COORD_TIMEOUT_S`` deadline must convert
+    the dead-peer hang into a clean rc-75 exit, the supervisor must
+    classify the -9 as oom_kill and SHRINK to the 1 survivor (elastic
+    resume off the last COMMITTED shard-native step — the manifest is
+    the commit marker, so the resumed iteration is pinned against the
+    manifests actually on disk), and the resumed world must finish all
+    12 steps. failure/heal events land in the supervisor's own
+    telemetry stream and the merged timeline across BOTH world sizes
+    plus the supervisor stream stays monotonic.
+
+    Scenario B (wedge -> bounded heal): ``wedge@step=3,secs=300,
+    proc=1`` stops process 1 stepping for 300 s while it KEEPS serving
+    HTTP — invisible to waitpid, invisible to /healthz. Only the
+    liveness monitor (/status step frozen past MGWFBP_LIVENESS_GRACE_S)
+    can see it; the group must be SIGTERMed, drain rc 75, relaunch at
+    the same world, and finish — in wall-clock time far under both the
+    300 s wedge and the 600 s barrier default. A slow detector or a
+    barrier-length hang fails the elapsed-time pin (and check.sh's
+    hard timeout)."""
+    import threading
+
+    from mgwfbp_tpu.runtime.supervisor import Supervisor, default_train_cmd
+    from mgwfbp_tpu.telemetry import events_of, find_stream_paths
+    from telemetry_merge import check_monotonic, merge_streams
+
+    out: dict = {"fault_smoke": "ok", "mode": "chaos"}
+
+    # ---- Scenario A: SIGKILL mid-epoch -> shrink to survivors --------
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_chaos_kill_") as d:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MGWFBP_HOST_DEVICES"] = "4"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # drain-less: process 1 dies with SIGKILL the moment it has
+        # stepped past 4 — the inc=0 default keeps the fault out of the
+        # healed incarnation (a drain-less fault resumes BELOW its own
+        # step and would re-fire forever otherwise)
+        env["MGWFBP_FAULT_PLAN"] = "kill@step=4,proc=1"
+        # the survivor must give up on the dead peer's collective in
+        # seconds, not DEFAULT_BARRIER_TIMEOUT_S — the bounded
+        # coordination contract is half of what this scenario pins
+        env["MGWFBP_COORD_TIMEOUT_S"] = "20"
+        env["MGWFBP_METRICS_PORT"] = str(_free_port())
+        # rs_opt_ag: sharded opt state, so the shrink really re-shards
+        sup = Supervisor(
+            default_train_cmd(_cli(d)[3:] + ["--comm-op", "rs_opt_ag"]),
+            2,
+            backoff_base_s=0.2,
+            drain_grace_s=90.0,
+            log_dir=os.path.join(d, "supervisor"),
+            env=env,
+        )
+        rc_box: dict = {}
+        runner = threading.Thread(
+            target=lambda: rc_box.update(rc=sup.run()), daemon=True
+        )
+        runner.start()
+        runner.join(timeout=600)
+        assert not runner.is_alive(), "chaos kill group wedged"
+        rc = rc_box.get("rc")
+        assert rc == 0, f"chaos kill run finished rc {rc}, want 0"
+        assert len(sup.results) == 2, (
+            f"expected kill + 1 healed incarnation, got "
+            f"{[r.returncodes for r in sup.results]}"
+        )
+        rcs0 = sup.results[0].returncodes
+        assert rcs0[1] == -9, f"process 1 did not die by SIGKILL: {rcs0}"
+        assert rcs0[0] == PREEMPT_RC, (
+            f"survivor exited rc {rcs0[0]}, want {PREEMPT_RC} — the "
+            "coordination deadline did not convert the dead-peer hang "
+            "into a restart-friendly exit"
+        )
+        assert sup.processes == 1, (
+            f"supervisor did not shrink to the survivor: {sup.processes}"
+        )
+        r1 = sup.results[1]
+        assert r1.ok and len(r1.returncodes) == 1, r1
+
+        # the commit marker is the manifest: resumed iteration must be
+        # the LAST committed shard-native step of the 2-process world
+        n8_tag = [
+            t for t in glob.glob(os.path.join(d, "ckpt", "*"))
+            if "-n8-" in os.path.basename(t)
+        ]
+        assert n8_tag, os.listdir(os.path.join(d, "ckpt"))
+        committed = sorted(
+            int(json.load(open(m))["step"]) for m in glob.glob(
+                os.path.join(n8_tag[0], "sharded", "*", "manifest.json")
+            )
+        )
+        assert committed, "no committed shard-native step survived"
+
+        sup_stream = os.path.join(
+            d, "supervisor", "telemetry.supervisor.jsonl"
+        )
+        assert os.path.exists(sup_stream), (
+            "supervisor telemetry stream missing"
+        )
+        tag_dirs = sorted(
+            p for p in glob.glob(os.path.join(d, "*"))
+            if os.path.isdir(p) and find_stream_paths(p)
+        )
+        assert len(tag_dirs) == 2, (
+            f"expected one tag dir per world size, got {tag_dirs}"
+        )
+        paths = [p for t in tag_dirs for p in find_stream_paths(t)]
+        assert len(paths) == 3, paths  # 2 streams at n8, 1 at n4
+        merged = merge_streams(paths + [sup_stream])
+        check_monotonic(merged)
+        fails = events_of(merged, "failure")
+        oom = [r for r in fails if r["class"] == "oom_kill"]
+        assert oom and oom[0]["target"] == "p1", fails
+        assert oom[0]["process"] == -1, oom  # the supervisor's verdict
+        heals = events_of(merged, "heal")
+        shrinks = [r for r in heals if r["action"] == "shrink"]
+        assert shrinks, heals
+        assert shrinks[0]["old_world"] == 2, shrinks
+        assert shrinks[0]["world"] == 1, shrinks
+        resumes = events_of(merged, "resize")
+        assert resumes and resumes[-1]["old_world"] == 8, resumes
+        assert resumes[-1]["new_world"] == 4, resumes
+        resumed = events_of(merged, "resume")
+        assert resumed, "healed incarnation recorded no resume event"
+        assert resumed[-1]["iteration"] == committed[-1], (
+            f"resumed at iteration {resumed[-1]['iteration']}, but the "
+            f"last committed shard-native step is {committed[-1]}"
+        )
+        last_step = max(r["step"] for r in events_of(merged, "step"))
+        assert last_step == 12, (
+            f"shrunk world stopped at step {last_step}, want 12"
+        )
+        out["kill"] = {
+            "incarnations": [r.returncodes for r in sup.results],
+            "shrunk_to": sup.processes,
+            "committed_steps": committed,
+            "resumed_iteration": resumed[-1]["iteration"],
+            "merged_records": len(merged),
+        }
+
+    # ---- Scenario B: wedge -> liveness verdict -> bounded heal -------
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="mgwfbp_chaos_wedge_") as d:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MGWFBP_HOST_DEVICES"] = "4"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # process 1 stops stepping for 300 s at step 3 but keeps serving
+        # HTTP — only the liveness monitor's frozen-step verdict can
+        # see this failure class
+        env["MGWFBP_FAULT_PLAN"] = "wedge@step=3,secs=300,proc=1"
+        env["MGWFBP_LIVENESS_GRACE_S"] = "6"
+        env["MGWFBP_COORD_TIMEOUT_S"] = "60"
+        env["MGWFBP_METRICS_PORT"] = str(_free_port())
+        sup = Supervisor(
+            default_train_cmd(_cli(d)[3:]),
+            2,
+            backoff_base_s=0.2,
+            drain_grace_s=90.0,
+            log_dir=os.path.join(d, "supervisor"),
+            env=env,
+        )
+        rc_box = {}
+        runner = threading.Thread(
+            target=lambda: rc_box.update(rc=sup.run()), daemon=True
+        )
+        runner.start()
+        runner.join(timeout=600)
+        assert not runner.is_alive(), "chaos wedge group wedged for real"
+        healed_in = time.monotonic() - t0
+        rc = rc_box.get("rc")
+        assert rc == 0, f"chaos wedge run finished rc {rc}, want 0"
+        # bounded: the heal must land in wall-clock time far under both
+        # the 300 s wedge and the 600 s barrier default — this elapsed
+        # pin is what makes "detected and healed in bounded time" a
+        # checked property instead of a hope
+        assert healed_in < 240, (
+            f"wedge heal took {healed_in:.0f}s — the liveness monitor "
+            "is not bounding detection"
+        )
+        assert len(sup.results) == 2, (
+            f"expected wedge + 1 healed incarnation, got "
+            f"{[r.returncodes for r in sup.results]}"
+        )
+        assert sup.results[0].returncodes == [PREEMPT_RC, PREEMPT_RC], (
+            f"SIGTERMed group did not drain restart-friendly: "
+            f"{sup.results[0].returncodes}"
+        )
+        assert sup.processes == 2, "wedge heal must NOT shrink the world"
+        r1 = sup.results[1]
+        assert r1.ok and len(r1.returncodes) == 2, r1
+
+        sup_stream = os.path.join(
+            d, "supervisor", "telemetry.supervisor.jsonl"
+        )
+        tag_dirs = sorted(
+            p for p in glob.glob(os.path.join(d, "*"))
+            if os.path.isdir(p) and find_stream_paths(p)
+        )
+        assert len(tag_dirs) == 1, tag_dirs  # same world both times
+        paths = find_stream_paths(tag_dirs[0])
+        assert len(paths) == 2, paths
+        merged = merge_streams(paths + [sup_stream])
+        check_monotonic(merged)
+        fails = events_of(merged, "failure")
+        wedged = [r for r in fails if r["class"] == "wedged"]
+        # the wedged process freezes its peer at the next merged
+        # collective inside the same grace window, so the verdict names
+        # the frozen SET — the actually-wedged p1 must be in it
+        assert wedged and "p1" in wedged[0]["target"].split(","), fails
+        assert wedged[0]["process"] == -1, wedged  # the monitor's verdict
+        heals = events_of(merged, "heal")
+        rel = [r for r in heals if r["action"] == "relaunch"]
+        assert rel and rel[0]["world"] == 2, heals
+        resumed = events_of(merged, "resume")
+        assert {r["process"] for r in resumed} == {0, 1}, resumed
+        for p in range(2):
+            last = max(
+                r["step"] for r in events_of(merged, "step")
+                if r["process"] == p
+            )
+            assert last == 12, f"process {p} stopped at step {last}"
+        out["wedge"] = {
+            "incarnations": [r.returncodes for r in sup.results],
+            "healed_in_s": round(healed_in, 1),
+            "wedged_failure_step": wedged[0].get("step"),
+            "merged_records": len(merged),
+        }
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--processes", type=int, default=1,
@@ -774,6 +1022,12 @@ def main() -> int:
                     help="async shard-writer lifecycle (ISSUE 16): "
                          "checkpoints-off vs async-ckpt step-time "
                          "envelope + async checkpoint event contract")
+    ap.add_argument("--chaos", action="store_true",
+                    help="self-healing lifecycle (ISSUE 20): SIGKILL a "
+                         "process mid-epoch (supervisor shrinks to the "
+                         "survivors off the last committed shard-native "
+                         "step) and wedge one (liveness monitor heals "
+                         "the group in bounded time)")
     ap.add_argument("--serve", action="store_true",
                     help="serving-plane lifecycle (ISSUE 19): "
                          "--serve-shadow run answering POST /predict "
@@ -781,7 +1035,9 @@ def main() -> int:
                          "mid-epoch commits, step-time envelope vs a "
                          "serve-off run")
     args = ap.parse_args()
-    if args.serve:
+    if args.chaos:
+        out = chaos_smoke()
+    elif args.serve:
         out = serve_smoke()
     elif args.async_ckpt:
         out = async_ckpt_smoke()

@@ -230,6 +230,22 @@ def format_report(records: list[dict]) -> str:
         ("resume", lambda r: (
             f"resumed at epoch {r.get('epoch')} iter {r.get('iteration')}"
             + (" (mid-epoch)" if r.get("mid_epoch") else " (boundary)"))),
+        ("failure", lambda r: (
+            f"FAILURE [{r.get('class')}] on {r.get('target')}"
+            + (f" rc {r.get('rc')}" if r.get("rc") is not None else "")
+            + (f" at step {r.get('step')}"
+               if r.get("step") is not None else "")
+            + (f" ({r.get('op')})" if r.get("op") else ""))),
+        ("heal", lambda r: (
+            f"HEAL {r.get('action')}"
+            + (f" [{r.get('class')}]" if r.get("class") else "")
+            + (f" {r.get('old_world')} -> {r.get('world')} proc(s)"
+               if r.get("action") == "shrink"
+               else (f" at world {r.get('world')}"
+                     if r.get("world") is not None else ""))
+            + (f" (reason: {r.get('reason')})" if r.get("reason") else "")
+            + (f" ({r.get('restarts')} restart(s))"
+               if r.get("restarts") is not None else ""))),
     ):
         for r in events_of(records, ev):
             lifecycle.append(render(r))
@@ -565,6 +581,8 @@ def format_live_report(status: dict, values: dict) -> str:
         ("mgwfbp_rollbacks_total", "rollbacks"),
         ("mgwfbp_preempts_total", "preempts"),
         ("mgwfbp_resumes_total", "resumes"),
+        ("mgwfbp_failures_total", "hard failures"),
+        ("mgwfbp_heals_total", "heals"),
         ("mgwfbp_watchdog_stalls_total", "watchdog stalls"),
         ("mgwfbp_autotune_commits_total", "autotune commits"),
         ("mgwfbp_drift_alarms_total", "drift alarms"),
@@ -627,6 +645,38 @@ def format_fleet_report(doc: dict) -> str:
             f"slowest: process {slow['process']} "
             f"(+{_fmt_s(slow['excess_s'])} s/step, "
             f"+{slow['excess_pct']:.1f}%)"
+        )
+    heal = doc.get("heal")
+    if heal:
+        lines.append("")
+        state = "enabled" if heal.get("enabled") else "DISABLED (--no-heal)"
+        lines.append(
+            f"self-healing: {state}, liveness grace "
+            f"{_fmt_s(heal.get('liveness_grace_s'))} s, budget "
+            f"{heal.get('budget')} restart(s)/class"
+        )
+        restarts = heal.get("restarts") or {}
+        if restarts:
+            lines.append(
+                "  heals so far: " + ", ".join(
+                    f"{cls}={n}" for cls, n in sorted(restarts.items())
+                )
+            )
+        pending = heal.get("pending_failure")
+        if pending:
+            lines.append(
+                f"  PENDING FAILURE: {pending.get('class')} on "
+                f"{pending.get('target')} (step {pending.get('step')}) "
+                "— draining to heal"
+            )
+    serving = doc.get("serving")
+    if serving:
+        lines.append("")
+        lines.append(
+            f"serve replicas: {serving.get('alive', 0)}/"
+            f"{serving.get('replicas', 0)} alive, restarts "
+            f"{serving.get('restarts')} (budget "
+            f"{serving.get('restart_budget')}/replica)"
         )
     alarms = doc.get("active_alarms") or []
     lines.append("")
@@ -765,6 +815,15 @@ def _synthetic_stream(path: str) -> None:
            latency_p50_s=0.018, latency_p95_s=0.035, latency_p99_s=0.04)
     w.emit("shadow_eval", step=8, loss=1.9, train_loss=1.8)
     w.emit("shadow_eval", step=16, loss=1.4, train_loss=1.35)
+    # self-healing supervisor (ISSUE 20): a hard-failure verdict and the
+    # healing action taken, as the supervisor's own stream records them
+    w.emit("failure", **{"class": "oom_kill"}, target="p1", rc=-9,
+           step=20)
+    w.emit("heal", action="shrink", **{"class": "oom_kill"}, target="p1",
+           old_world=2, world=1, restarts=1)
+    w.emit("failure", **{"class": "wedged"}, target="p0,p1", step=21)
+    w.emit("heal", action="relaunch", **{"class": "wedged"},
+           target="p0,p1", world=2, restarts=1)
     w.close()
 
 
@@ -807,6 +866,17 @@ def selftest() -> int:
         assert "queue depth: first 1 -> last 0" in report, report
         assert "shadow eval: 2 scores" in report, report
         assert "vs training loss 1.35 (delta +0.05)" in report, report
+        # ISSUE 20: failure verdicts and healing actions render in the
+        # lifecycle section
+        assert "FAILURE [oom_kill] on p1 rc -9 at step 20" in report
+        assert (
+            "HEAL shrink [oom_kill] 2 -> 1 proc(s) (1 restart(s))"
+            in report
+        ), report
+        assert "FAILURE [wedged] on p0,p1 at step 21" in report, report
+        assert (
+            "HEAL relaunch [wedged] at world 2 (1 restart(s))" in report
+        ), report
         trace_path = os.path.join(d, "trace.json")
         doc = write_chrome_trace(trace_path, records)
         with open(trace_path) as f:
@@ -833,6 +903,8 @@ def selftest() -> int:
         assert "mgwfbp_serve_step 16" in prom, prom
         assert "mgwfbp_serve_latency_p95_seconds 0.035" in prom, prom
         assert "mgwfbp_shadow_eval_delta 0.05" in prom, prom
+        assert "mgwfbp_failures_total 2" in prom, prom
+        assert "mgwfbp_heals_total 2" in prom, prom
         # --live round trip: serve the replayed aggregator over HTTP and
         # render the live report from /status + /metrics; then fan two
         # such children into a fleet view (ISSUE 10) and render that
@@ -845,6 +917,18 @@ def selftest() -> int:
             lambda: {0: ("127.0.0.1", srv.port),
                      1: ("127.0.0.1", srv.port)},
             port=0,
+            # the supervisor's heal/serving state flows through the
+            # fan-in meta verbatim (ISSUE 20)
+            meta_provider=lambda: {
+                "heal": {
+                    "enabled": True, "restarts": {"oom_kill": 1},
+                    "budget": 2, "liveness_grace_s": 120.0,
+                },
+                "serving": {
+                    "replicas": 2, "alive": 1, "restarts": [0, 2],
+                    "restart_budget": 3,
+                },
+            },
         )
         try:
             code, body = _fetch(f"http://127.0.0.1:{srv.port}/status")
@@ -858,6 +942,9 @@ def selftest() -> int:
             # from /status's `serving` document
             assert "serving: step 16, 2 hot-reload(s)" in live, live
             assert "shadow eval (step 16)" in live, live
+            # ISSUE 20: failure/heal lifecycle counters in the live view
+            assert "hard failures: 2" in live, live
+            assert "heals: 2" in live, live
             children = scrape_fleet(
                 {0: ("127.0.0.1", srv.port), 1: ("127.0.0.1", srv.port)}
             )
@@ -875,6 +962,15 @@ def selftest() -> int:
             )
             assert 'mgwfbp_steps_total{process="0"} 24' in fmet, fmet
             assert 'mgwfbp_steps_total{process="1"} 24' in fmet, fmet
+            # ISSUE 20: the supervisor's heal + serve-replica state
+            # renders in the fleet view
+            freport = format_fleet_report(fdoc)
+            assert "self-healing: enabled" in freport, freport
+            assert "heals so far: oom_kill=1" in freport, freport
+            assert (
+                "serve replicas: 1/2 alive, restarts [0, 2] "
+                "(budget 3/replica)" in freport
+            ), freport
             print(format_fleet_report(fdoc))
             print()
         finally:
